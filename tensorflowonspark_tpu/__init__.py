@@ -1,0 +1,50 @@
+"""tensorflowonspark_tpu — a TPU-native cluster-bootstrap and data-feeding framework.
+
+A brand-new framework with the capabilities of TensorFlowOnSpark
+(reference: ``tensorflowonspark/__init__.py``, ``README.md``): it turns a generic
+task-scheduling cluster (Apache Spark when available, or the built-in multi-process
+local backend) into a distributed JAX/TPU cluster.  Where the reference bootstraps a
+``TF_CONFIG`` worker/PS gRPC mesh with NCCL allreduce on GPUs
+(reference ``TFSparkNode.py:278-286``), this framework bootstraps a
+``jax.distributed`` coordinator plus a ``jax.sharding.Mesh`` over TPU-pod hosts,
+with collectives running on ICI/DCN and data entering via per-host batched infeed.
+
+Layer map (mirrors reference SURVEY layers L2-L5, re-designed TPU-first):
+
+- :mod:`~tensorflowonspark_tpu.cluster`     — driver-side lifecycle API
+  (``run/train/inference/shutdown``; reference ``TFCluster.py``)
+- :mod:`~tensorflowonspark_tpu.node`        — per-executor node runtime
+  (reference ``TFSparkNode.py``)
+- :mod:`~tensorflowonspark_tpu.reservation` — rendezvous server/client
+  (JSON over TCP; reference ``reservation.py`` used pickled messages)
+- :mod:`~tensorflowonspark_tpu.manager`     — per-executor IPC broker
+  (reference ``TFManager.py``)
+- :mod:`~tensorflowonspark_tpu.datafeed`    — user-side data feed, batched for
+  TPU infeed (reference ``TFNode.py``)
+- :mod:`~tensorflowonspark_tpu.backend`     — cluster execution backends
+  (Spark when pyspark is installed, built-in LocalBackend otherwise)
+- :mod:`~tensorflowonspark_tpu.pipeline`    — ML Estimator/Model pipeline
+  (reference ``pipeline.py``)
+- :mod:`~tensorflowonspark_tpu.dfutil`      — TFRecord <-> rows converters
+  (reference ``dfutil.py``; codec is first-party C++/Python, no Hadoop jar)
+- :mod:`~tensorflowonspark_tpu.parallel`    — device meshes, collectives,
+  sequence parallelism (ring attention) — the TPU-native data plane that replaces
+  the reference's delegated gRPC/NCCL layer
+- :mod:`~tensorflowonspark_tpu.models`      — flax model zoo for the example
+  workloads (MNIST CNN, ResNet, U-Net, Transformer LM)
+"""
+
+import logging
+import os
+
+# Match the reference's package-wide logging setup (reference __init__.py:1-5):
+# INFO level with thread/process ids so interleaved executor logs are
+# attributable.  basicConfig is a no-op if the application already configured
+# the root logger; set TFOS_TPU_NO_LOG_CONFIG=1 to suppress it entirely.
+if not os.environ.get("TFOS_TPU_NO_LOG_CONFIG"):
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s (%(threadName)s-%(process)d) %(message)s",
+    )
+
+__version__ = "0.1.0"

@@ -1,0 +1,304 @@
+"""Cluster execution backends: who runs the long-lived per-host tasks.
+
+The reference framework is welded to Apache Spark: the driver runs "jobs" whose
+tasks are scheduled one-per-executor, executors are long-lived OS processes
+that persist across jobs, and data reaches tasks as partition iterators
+(reference ``TFCluster.py:312-329``, ``TFSparkNode.py:121-135``).  This module
+abstracts exactly that contract so the TPU framework can run on:
+
+- :class:`SparkBackend`  — a thin adapter over a live ``SparkContext`` (used
+  when ``pyspark`` is installed; API-compatible with the reference deployment).
+- :class:`LocalBackend`  — a built-in multi-process standalone cluster: N
+  long-lived executor processes on this host, a driver-side scheduler that
+  dispatches one task per free executor, per-executor working directories, and
+  partition-iterator task semantics.  This is the moral equivalent of the
+  reference's test rig (a local Spark Standalone cluster with separate worker
+  processes, ``test/run_tests.sh:15-22``, ``test/README.md:10``) promoted to a
+  first-class deployment mode — one process per TPU host is the natural
+  granularity for JAX/libtpu anyway (SURVEY §7.2).
+
+The backend contract (used by :mod:`~tensorflowonspark_tpu.cluster`):
+
+- ``foreach_partition_async(partitions, fn) -> JobHandle`` — run ``fn(iter)``
+  once per partition on some executor; non-blocking ("start job" / "feed job").
+- ``map_partitions(partitions, fn) -> list`` — run ``fn(iter)`` per partition,
+  collect per-partition result lists (inference results job).
+- one task slot per executor: a task occupies its executor until it returns,
+  which is what lets the framework co-locate feed tasks with the long-running
+  node process via the executor-id working-dir handshake (``util.py:66-75``).
+"""
+
+import logging
+import os
+import queue as _queue
+import shutil
+import tempfile
+import threading
+import traceback
+
+import cloudpickle
+from multiprocessing import get_context
+
+logger = logging.getLogger(__name__)
+
+
+def partition(data, num_partitions):
+    """Split a list into ``num_partitions`` contiguous partitions.
+
+    The local-mode stand-in for ``sc.parallelize(data, n)``; Spark's formula
+    (elements spread as evenly as possible) is used so partition sizes match
+    what the reference's feeders would see.
+    """
+    items = list(data)
+    n = len(items)
+    out = []
+    for i in range(num_partitions):
+        start = (i * n) // num_partitions
+        stop = ((i + 1) * n) // num_partitions
+        out.append(items[start:stop])
+    return out
+
+
+class JobHandle(object):
+    """Handle for an asynchronously running backend job."""
+
+    def __init__(self, num_tasks):
+        self.num_tasks = num_tasks
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self._completed = 0
+        self.error = None  # first task error (formatted traceback string)
+        self.results = [None] * num_tasks
+
+    def _task_done(self, index, ok, payload):
+        with self._lock:
+            if ok:
+                self.results[index] = payload
+            elif self.error is None:
+                self.error = payload
+            self._completed += 1
+            if self._completed >= self.num_tasks or not ok:
+                self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        """Block until all tasks finished; raises on the first task error."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("job did not complete within {}s".format(timeout))
+        if self.error is not None:
+            raise RuntimeError("job failed:\n{}".format(self.error))
+        return self.results
+
+
+# ---------------------------------------------------------------------------
+# LocalBackend: executor worker process main loop
+# ---------------------------------------------------------------------------
+
+def _executor_main(executor_index, workdir, conn, env_overrides):
+    """Long-lived executor process: apply env, chdir, serve tasks over a pipe.
+
+    Tasks arrive as ``(task_id, pickled_fn, partition_items)``; results return
+    as ``(task_id, ok, result_or_traceback)``.  Environment overrides are
+    applied *before* any task runs so that e.g. ``JAX_PLATFORMS`` is set before
+    the first ``import jax`` in user code.
+    """
+    os.environ.update(env_overrides or {})
+    os.makedirs(workdir, exist_ok=True)
+    os.chdir(workdir)
+    import threading as _threading
+
+    _threading.current_thread().name = "executor-{}".format(executor_index)
+    while True:
+        try:
+            msg = conn.recv()
+        except EOFError:
+            break
+        if msg is None:  # backend shutdown
+            break
+        task_id, fn_bytes, items = msg
+        try:
+            fn = cloudpickle.loads(fn_bytes)
+            result = fn(iter(items))
+            if result is not None and not isinstance(result, (list, tuple)):
+                result = list(result)  # drain generators inside the executor
+            conn.send((task_id, True, result))
+        except Exception:
+            conn.send((task_id, False, traceback.format_exc()))
+
+
+class LocalBackend(object):
+    """Built-in standalone cluster: N long-lived executor processes on this host.
+
+    Args:
+      num_executors: number of executor processes.
+      env: base environment overrides applied in every executor before the
+        first task (e.g. ``{"JAX_PLATFORMS": "cpu"}`` for tests).
+      env_per_executor: optional list of per-executor override dicts (e.g. to
+        give exactly one executor the real TPU and the rest CPU).
+      workdir_root: parent directory for per-executor working dirs (a fresh
+        temp dir by default); each executor gets ``<root>/executor-<i>``, its
+        own cwd, which is what makes the executor-id file handshake work.
+    """
+
+    def __init__(self, num_executors, env=None, env_per_executor=None, workdir_root=None):
+        self.num_executors = num_executors
+        self._owns_root = workdir_root is None
+        self.workdir_root = workdir_root or tempfile.mkdtemp(prefix="tfos_tpu_local_")
+        self._ctx = get_context("spawn")
+        self._procs = []
+        self._conns = []
+        self._free = _queue.Queue()
+        self._stopped = False
+        for i in range(num_executors):
+            overrides = dict(env or {})
+            if env_per_executor:
+                overrides.update(env_per_executor[i] or {})
+            parent_conn, child_conn = self._ctx.Pipe()
+            proc = self._ctx.Process(
+                target=_executor_main,
+                args=(
+                    i,
+                    os.path.join(self.workdir_root, "executor-{}".format(i)),
+                    child_conn,
+                    overrides,
+                ),
+                name="local-executor-{}".format(i),
+            )
+            proc.start()
+            child_conn.close()
+            self._procs.append(proc)
+            self._conns.append(parent_conn)
+            self._free.put(i)
+
+    # -- scheduling -------------------------------------------------------
+
+    def _run_one(self, executor_index, task_id, fn_bytes, items, handle):
+        conn = self._conns[executor_index]
+        try:
+            conn.send((task_id, fn_bytes, items))
+            rid, ok, payload = conn.recv()
+            assert rid == task_id
+            handle._task_done(task_id, ok, payload)
+        except (EOFError, OSError):
+            if self._stopped:
+                return
+            handle._task_done(
+                task_id,
+                False,
+                "executor {} died while running task {} (exitcode={})".format(
+                    executor_index, task_id, self._procs[executor_index].exitcode
+                ),
+            )
+        finally:
+            if self._procs[executor_index].is_alive():
+                self._free.put(executor_index)
+
+    def foreach_partition_async(self, partitions, fn):
+        """Dispatch ``fn(iter(partition))`` per partition onto free executors."""
+        handle = JobHandle(len(partitions))
+        fn_bytes = cloudpickle.dumps(fn)
+
+        def _dispatch():
+            threads = []
+            for task_id, items in enumerate(partitions):
+                executor_index = self._free.get()  # blocks until a slot frees up
+                if self._stopped:
+                    handle._task_done(task_id, False, "backend stopped")
+                    continue
+                t = threading.Thread(
+                    target=self._run_one,
+                    args=(executor_index, task_id, fn_bytes, list(items), handle),
+                    name="task-{}".format(task_id),
+                    daemon=True,
+                )
+                t.start()
+                threads.append(t)
+
+        threading.Thread(target=_dispatch, name="job-dispatch", daemon=True).start()
+        return handle
+
+    def foreach_partition(self, partitions, fn, timeout=None):
+        self.foreach_partition_async(partitions, fn).wait(timeout)
+
+    def map_partitions(self, partitions, fn, timeout=None):
+        """Run ``fn`` per partition and return the list of per-partition results."""
+        return self.foreach_partition_async(partitions, fn).wait(timeout)
+
+    def stop(self):
+        self._stopped = True
+        for conn in self._conns:
+            try:
+                conn.send(None)
+            except OSError:
+                pass
+        for proc in self._procs:
+            proc.join(timeout=5)
+            if proc.is_alive():
+                proc.terminate()
+        if self._owns_root:
+            shutil.rmtree(self.workdir_root, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# SparkBackend: adapter over a live SparkContext (requires pyspark)
+# ---------------------------------------------------------------------------
+
+class SparkBackend(object):
+    """Adapter over ``pyspark.SparkContext`` matching the backend contract.
+
+    Deployment-equivalent to the reference: the "start job" is
+    ``sc.parallelize(range(n), n).foreachPartition(fn)`` on a background thread
+    (reference ``TFCluster.py:312-329``) and feed jobs are ``rdd.foreachPartition``
+    / ``rdd.mapPartitions`` (reference ``TFCluster.py:92,113``).  Requires one
+    task slot per executor, exactly like the reference
+    (``TFSparkNode.py:110-115``).
+
+    ``partitions`` arguments may be RDDs (used as-is) or lists (parallelized).
+    """
+
+    def __init__(self, sc, num_executors=None):
+        import pyspark  # gated: only needed when this backend is chosen
+
+        assert isinstance(sc, pyspark.SparkContext)
+        self.sc = sc
+        self.num_executors = num_executors or int(
+            sc.getConf().get("spark.executor.instances", "1")
+        )
+
+    def _to_rdd(self, partitions):
+        if hasattr(partitions, "foreachPartition"):  # already an RDD
+            return partitions
+        flat = [item for part in partitions for item in part]
+        return self.sc.parallelize(flat, len(partitions))
+
+    def foreach_partition_async(self, partitions, fn):
+        rdd = self._to_rdd(partitions)
+        handle = JobHandle(rdd.getNumPartitions())
+
+        def _run():
+            try:
+                rdd.foreachPartition(fn)
+                for i in range(handle.num_tasks):
+                    handle._task_done(i, True, None)
+            except Exception:
+                handle._task_done(0, False, traceback.format_exc())
+
+        threading.Thread(target=_run, name="spark-job", daemon=True).start()
+        return handle
+
+    def foreach_partition(self, partitions, fn, timeout=None):
+        self.foreach_partition_async(partitions, fn).wait(timeout)
+
+    def map_partitions(self, partitions, fn, timeout=None):
+        rdd = self._to_rdd(partitions)
+        return rdd.mapPartitions(lambda it: [fn(it)]).collect()
+
+    def stop(self):
+        pass  # the caller owns the SparkContext's lifecycle
+
+    @property
+    def default_fs(self):
+        """Filesystem defaultFS from the Hadoop conf (reference TFCluster.py:269-272)."""
+        return self.sc._jsc.hadoopConfiguration().get("fs.defaultFS")

@@ -1,0 +1,324 @@
+"""Driver-side cluster lifecycle API (reference ``TFCluster.py``).
+
+``run()`` turns a backend's executors into a JAX/TPU cluster: it computes the
+role template, starts the rendezvous server, launches one long-running node
+task per executor, waits for all nodes to register, and returns a
+:class:`TPUCluster` whose ``train/inference/shutdown`` drive the data plane
+(reference call stacks SURVEY §3.1-§3.5).
+
+Input modes (reference ``TFCluster.py:41-44``):
+
+- ``InputMode.FILES``  (reference name ``TENSORFLOW``): nodes read their data
+  directly from shared storage; the cluster only orchestrates lifecycle.
+- ``InputMode.SPARK``: the backend pushes dataset partitions through
+  per-executor queues into the nodes (feed jobs with backpressure).
+"""
+
+import logging
+import random
+import signal
+import sys
+import threading
+import time
+import uuid
+
+from tensorflowonspark_tpu import backend as backend_mod
+from tensorflowonspark_tpu import node, reservation
+
+logger = logging.getLogger(__name__)
+
+
+class InputMode(object):
+    """How data reaches the nodes (reference ``TFCluster.py:41-44``)."""
+
+    TENSORFLOW = 0  # reference-compat alias for FILES
+    FILES = 0       # nodes read files from shared storage themselves
+    SPARK = 1       # backend pushes dataset partitions via queues
+
+
+class TPUCluster(object):
+    """Handle for a running cluster (reference ``TFCluster`` object,
+    ``TFCluster.py:29-207``)."""
+
+    def __init__(self, backend, cluster_meta, cluster_info, input_mode,
+                 server, start_job, tf_status, queues):
+        self.backend = backend
+        self.cluster_meta = cluster_meta
+        self.cluster_info = cluster_info
+        self.input_mode = input_mode
+        self.server = server
+        self.start_job = start_job
+        self.tf_status = tf_status
+        self.queues = queues
+
+    # -- data plane -------------------------------------------------------
+
+    def train(self, data, num_epochs=1, feed_timeout=600, qname="input"):
+        """Feed partitioned data for training (InputMode.SPARK only;
+        reference ``TFCluster.py:61-92``).
+
+        ``data`` may be:
+        - a list of partitions (built-in backend) or an RDD (Spark backend);
+          epochs are fed by repeating the partition list (reference
+          ``sc.union([rdd]*num_epochs)``, ``TFCluster.py:88-91``);
+        - an *iterator/generator of partitions* for streaming: fed until
+          exhausted or a STOP is requested (reference DStream branch,
+          ``TFCluster.py:81-83``).
+        """
+        logger.info("Feeding training data")
+        assert self.input_mode == InputMode.SPARK, \
+            "train() feeding requires InputMode.SPARK"
+        assert num_epochs >= 0
+        fn = node.train(self.cluster_info, self.cluster_meta, qname, feed_timeout)
+        if hasattr(data, "__next__"):  # streaming source: unbounded partitions
+            for part in data:
+                if self.server.done:
+                    logger.info("STOP requested; ending streaming feed")
+                    break
+                self.backend.foreach_partition([part], fn)
+        elif hasattr(data, "foreachPartition"):  # Spark RDD
+            rdd = data
+            if num_epochs > 1:
+                rdd = self.backend.sc.union([rdd] * num_epochs)
+            self.backend.foreach_partition(rdd, fn)
+        else:
+            partitions = list(data) * max(num_epochs, 1)
+            self.backend.foreach_partition(partitions, fn)
+
+    def inference(self, data, qname="input"):
+        """Feed data for inference, returning per-item results (reference
+        ``TFCluster.py:94-113``).  Results preserve partition order; the
+        1:1 item/result contract is enforced by the node feeder."""
+        logger.info("Feeding inference data")
+        assert self.input_mode == InputMode.SPARK, \
+            "inference() feeding requires InputMode.SPARK"
+        fn = node.inference(self.cluster_info, self.cluster_meta, qname)
+        results = self.backend.map_partitions(data, fn)
+        if hasattr(results, "collect"):  # Spark path returns an RDD-like
+            return results
+        return [item for part in results if part for item in part]
+
+    # -- lifecycle --------------------------------------------------------
+
+    def shutdown(self, grace_secs=0, timeout=259200):
+        """Stop the cluster and surface any node errors (reference
+        ``TFCluster.py:115-200``).
+
+        For FILES mode, waits for worker node tasks to finish their user fn
+        first (reference statusTracker polling, ``TFCluster.py:152-167``).
+        Exits the driver with status 1 if any node raised (reference
+        ``TFCluster.py:177-181``) — fail-fast, so schedulers notice.
+        """
+        logger.info("Stopping cluster")
+        timer = None
+        if timeout > 0 and threading.current_thread() is threading.main_thread():
+            # Watchdog so a hung node cannot wedge the driver forever
+            # (reference SIGALRM watchdog, TFCluster.py:134-142).
+            def _watchdog(signum, frame):
+                logger.error("shutdown timeout after %ds; exiting", timeout)
+                self.backend.stop()
+                sys.exit(1)
+
+            signal.signal(signal.SIGALRM, _watchdog)
+            signal.alarm(timeout)
+            timer = True
+
+        ps_like = [n for n in self.cluster_info
+                   if n["job_name"] in ("ps", "evaluator")]
+        workers = [n for n in self.cluster_info
+                   if n["job_name"] in ("chief", "master", "worker")]
+
+        if self.input_mode == InputMode.FILES:
+            # Workers run the user fn inline in their start task; wait for
+            # those tasks to complete before poisoning queues (reference
+            # active-task polling, TFCluster.py:152-167).
+            num_worker_tasks = len(workers)
+            while not self.start_job.done():
+                if self.start_job.error:
+                    break
+                if self.start_job._completed >= num_worker_tasks:
+                    break  # all worker tasks returned; only ps-like still parked
+                time.sleep(1)
+
+        # Poison each worker's queues via a shutdown job; tasks land on free
+        # (worker) executors since ps-like executors stay parked (reference
+        # SPARK JOB #3, TFCluster.py:172-174).  Task placement is not
+        # guaranteed, so each task reports the node it reached and we retry
+        # until every worker node confirms (poisoning is idempotent).
+        fn = node.shutdown(self.cluster_info, self.cluster_meta,
+                           queues=self.queues, grace_secs=grace_secs)
+        worker_ids = {n["executor_id"] for n in workers}
+        covered = set()
+        for attempt in range(3):
+            pending = sorted(worker_ids - covered)
+            if not pending:
+                break
+            try:
+                results = self.backend.map_partitions(
+                    [[i] for i in pending], fn,
+                    timeout=grace_secs + 120)
+                for part in results:
+                    if part:
+                        covered.add(part[0])
+            except (RuntimeError, TimeoutError) as e:
+                self.tf_status["error"] = str(e)
+                break
+        else:
+            if worker_ids - covered and "error" not in self.tf_status:
+                logger.warning(
+                    "could not confirm shutdown of nodes %s; their executors "
+                    "may have died", sorted(worker_ids - covered))
+
+        if "error" in self.tf_status:
+            logger.error("cluster failed: %s", self.tf_status["error"])
+            self.backend.stop()
+            if timer:
+                signal.alarm(0)
+            sys.exit(1)
+
+        # Stop ps-like nodes: the driver reaches their remote managers
+        # directly and signals their control queues (reference
+        # TFCluster.py:186-192).
+        for n in ps_like:
+            try:
+                from tensorflowonspark_tpu import manager as mgr_mod
+
+                m = mgr_mod.connect(n["addr"], bytes.fromhex(n["authkey"]))
+                ctrl = m.get_queue("control")
+                ctrl.put(None, block=True)
+                ctrl.join()
+            except Exception:
+                logger.warning("failed to signal %s:%d for shutdown",
+                               n["job_name"], n["task_index"], exc_info=True)
+
+        # Wait for the start job to fully drain (reference TFCluster.py:195-200).
+        try:
+            self.start_job.wait(timeout=max(grace_secs, 60))
+        except TimeoutError:
+            logger.warning("start job did not fully drain; continuing shutdown")
+        except RuntimeError as e:
+            logger.error("cluster failed: %s", e)
+            if timer:
+                signal.alarm(0)
+            sys.exit(1)
+
+        if timer:
+            signal.alarm(0)
+        self.server.stop()
+        logger.info("cluster stopped")
+
+    def tensorboard_url(self):
+        """URL of the cluster-managed TensorBoard, if launched (reference
+        ``TFCluster.py:202-207``)."""
+        for n in self.cluster_info:
+            if n.get("tb_port"):
+                return "http://{}:{}".format(n["host"], n["tb_port"])
+        return None
+
+
+def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
+        tensorboard=False, input_mode=InputMode.FILES, log_dir=None,
+        master_node=None, reservation_timeout=600,
+        queues=("input", "output", "error"), eval_node=False,
+        release_port=True):
+    """Start a cluster: one long-running node task per executor (reference
+    ``TFCluster.py:210-378``).
+
+    Args:
+      cluster_backend: a :mod:`~tensorflowonspark_tpu.backend` backend (or a
+        ``SparkContext``, which is wrapped in a :class:`SparkBackend`).
+      map_fun: user function ``fn(args, ctx)`` run on every node.
+      tf_args: argparse Namespace or argv list for ``map_fun``.
+      num_executors: cluster size (defaults to the backend's executor count).
+      num_ps: number of long-running non-worker ("ps"-like) roles — kept for
+        capability parity (reference async-PS mode, SURVEY §2.4); TPU training
+        itself is synchronous.
+      master_node: name for the chief role (``None`` → plain ``worker`` 0 is
+        chief, reference ``TFCluster.py:225,257-258``).
+      eval_node: dedicate one node as ``evaluator`` (reference ``TFCluster.py:228``).
+      input_mode: :class:`InputMode`.
+    """
+    if hasattr(cluster_backend, "parallelize"):  # raw SparkContext
+        cluster_backend = backend_mod.SparkBackend(cluster_backend)
+    num_executors = num_executors or cluster_backend.num_executors
+
+    # Role template: {job_name: [executor_ids]} (reference TFCluster.py:250-264).
+    num_workers = num_executors - num_ps - (1 if eval_node else 0)
+    assert num_workers > 0, (
+        "num_executors={} leaves no workers after num_ps={} eval_node={}".format(
+            num_executors, num_ps, eval_node))
+    executors = list(range(num_executors))
+    cluster_template = {}
+    if num_ps > 0:
+        cluster_template["ps"] = executors[:num_ps]
+        del executors[:num_ps]
+    if eval_node:
+        cluster_template["evaluator"] = executors[:1]
+        del executors[:1]
+    if master_node is None:
+        cluster_template["worker"] = executors
+    else:
+        cluster_template[master_node] = executors[:1]
+        if len(executors) > 1:
+            cluster_template["worker"] = executors[1:]
+    logger.info("cluster template: %s", cluster_template)
+
+    # Rendezvous server (reference TFCluster.py:277-279).
+    server = reservation.Server(num_executors)
+    server_addr = server.start()
+
+    cluster_meta = {
+        "id": "{:x}".format(random.getrandbits(64)),
+        "cluster_template": cluster_template,
+        "num_executors": num_executors,
+        "default_fs": getattr(cluster_backend, "default_fs", "file://"),
+        "server_addr": list(server_addr),
+        "authkey": uuid.uuid4().bytes.hex(),
+        "reservation_timeout": reservation_timeout,
+        "input_mode": input_mode,
+    }
+
+    # Launch the start job in the background (reference daemon thread +
+    # foreachPartition, TFCluster.py:312-329): SPARK-mode workers run the user
+    # fn in a background process so their task returns and frees the slot for
+    # feed jobs; FILES-mode workers hold the slot for the whole run.
+    background = (input_mode == InputMode.SPARK)
+    start_fn = node.run(map_fun, tf_args, cluster_meta, tensorboard=tensorboard,
+                        log_dir=log_dir, queues=tuple(queues),
+                        background=background, release_port=release_port)
+    start_parts = backend_mod.partition(range(num_executors), num_executors)
+    start_job = cluster_backend.foreach_partition_async(start_parts, start_fn)
+
+    # Propagate async start-job failures into the reservation wait (reference
+    # tf_status error flag, TFCluster.py:38,321-323 + reservation.py:117-120).
+    tf_status = {}
+
+    def _monitor():
+        while not start_job.done():
+            if start_job.error:
+                break
+            time.sleep(0.5)
+        if start_job.error:
+            tf_status["error"] = start_job.error
+
+    threading.Thread(target=_monitor, name="start-job-monitor", daemon=True).start()
+
+    cluster_info = server.await_reservations(
+        status=tf_status, timeout=reservation_timeout)
+    cluster_info.sort(key=node._sort_key)
+    logger.info("cluster nodes: %s",
+                [(n["job_name"], n["task_index"], n["host"]) for n in cluster_info])
+
+    # Duplicate-node sanity check (reference TFCluster.py:350-365).
+    seen = set()
+    for n in cluster_info:
+        key = (n["host"], n["executor_id"])
+        if key in seen:
+            raise Exception(
+                "Duplicate cluster node on executor {} of host {}: executors "
+                "must provide exactly one task slot each (disable dynamic "
+                "allocation / over-subscription).".format(n["executor_id"], n["host"]))
+        seen.add(key)
+
+    return TPUCluster(cluster_backend, cluster_meta, cluster_info, input_mode,
+                      server, start_job, tf_status, tuple(queues))

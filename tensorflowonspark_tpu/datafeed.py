@@ -1,0 +1,180 @@
+"""User-side data feed helpers, used inside ``main_fun`` (reference ``TFNode.py``).
+
+:class:`DataFeed` consumes the per-executor input queue as batches and pushes
+inference results back — same queue semantics as the reference (end-of-feed
+``None``, :class:`~tensorflowonspark_tpu.marker.EndPartition` alignment, the
+1:1 inference contract) — but adds TPU-first batch assembly: instead of the
+reference's element-at-a-time generator hops into ``tf.data.from_generator``
+(the known InputMode.SPARK bottleneck, SURVEY §3.2), :meth:`next_batch` can
+return columnar numpy arrays ready for a single per-host ``jax.device_put``
+into a sharded global batch (see :mod:`tensorflowonspark_tpu.parallel.infeed`).
+"""
+
+import logging
+import queue as _queue
+
+import numpy as np
+
+from tensorflowonspark_tpu import marker
+
+logger = logging.getLogger(__name__)
+
+
+def absolute_path(ctx, path):
+    """Convert a user path to an absolute path on shared storage.
+
+    Reference ``TFNode.py:23-58`` (``hdfs_path``); scheme list extended with
+    the TPU-era object stores (``gs://``, ``s3://``).
+
+    Rules:
+    - recognized scheme prefixes pass through unchanged;
+    - absolute paths pass through (prefixed with ``file://`` when default_fs
+      is local);
+    - relative paths resolve against the default filesystem, or against the
+      executor's working dir when default_fs is ``file://`` (reference
+      behavior for Spark Standalone).
+    """
+    schemes = ("file://", "hdfs://", "viewfs://", "gs://", "s3://", "s3a://")
+    if path.startswith(schemes):
+        return path
+    default_fs = getattr(ctx, "default_fs", None) or "file://"
+    if path.startswith("/"):
+        return path if not default_fs.startswith("file://") else "file://" + path
+    if default_fs.startswith("file://"):
+        working_dir = getattr(ctx, "working_dir", None) or "."
+        return "file://{}/{}".format(working_dir, path)
+    if default_fs.startswith("hdfs://") or default_fs.startswith("viewfs://"):
+        # hdfs relative paths resolve to the user's home dir (reference
+        # TFNode.py:52-53).
+        import getpass
+
+        return "{}/user/{}/{}".format(default_fs.rstrip("/"), getpass.getuser(), path)
+    return "{}/{}".format(default_fs.rstrip("/"), path)
+
+
+def strip_scheme(path):
+    """Drop a ``file://`` prefix for direct POSIX access."""
+    return path[len("file://"):] if path.startswith("file://") else path
+
+
+class DataFeed(object):
+    """Queue consumer for InputMode.SPARK nodes (reference ``TFNode.py:86-194``).
+
+    Args:
+      mgr: this node's connected manager (from ``ctx.mgr``).
+      train_mode: True for training (no result queue), False for inference.
+      qname_in / qname_out: queue names.
+      input_mapping: optional ``{column_name: tensor_name}`` dict; when given,
+        :meth:`next_batch` returns a dict of per-tensor columns, keyed by
+        tensor name, with columns ordered by sorted column name — the same
+        contract the pipeline API uses to line up DataFrame columns
+        (reference ``TFNode.py:96-103``, ``pipeline.py:428-429``).
+    """
+
+    def __init__(self, mgr, train_mode=True, qname_in="input",
+                 qname_out="output", input_mapping=None):
+        self.mgr = mgr
+        self.train_mode = train_mode
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.done_feeding = False
+        self.input_tensors = (
+            [tensor for _, tensor in sorted(input_mapping.items())]
+            if input_mapping is not None else None
+        )
+
+    def next_batch(self, batch_size):
+        """Get up to ``batch_size`` items from the input queue.
+
+        Blocks until data is available.  Returns fewer than ``batch_size``
+        items at end-of-feed (``None`` sentinel) or at a partition boundary
+        during inference (``EndPartition``) — reference ``TFNode.py:105-151``.
+
+        Returns a list of items, or a dict of per-tensor lists when
+        ``input_mapping`` was provided.
+        """
+        logger.debug("requesting batch of %d items", batch_size)
+        queue = self.mgr.get_queue(self.qname_in)
+        tensors = ([] if self.input_tensors is None
+                   else {tensor: [] for tensor in self.input_tensors})
+        count = 0
+        while count < batch_size:
+            item = queue.get(block=True)
+            if item is None:
+                # End-of-feed: producers are done for good (reference 129-134).
+                logger.info("next_batch: end of feed")
+                self.done_feeding = True
+                queue.task_done()
+                break
+            elif isinstance(item, marker.EndPartition):
+                # Partition boundary: stop here if we already have items so
+                # result batches align with partitions (reference 135-140).
+                logger.debug("next_batch: end of partition")
+                queue.task_done()
+                if count > 0:
+                    break
+            else:
+                if self.input_tensors is None:
+                    tensors.append(item)
+                else:
+                    for i, tensor in enumerate(self.input_tensors):
+                        tensors[tensor].append(item[i])
+                count += 1
+                queue.task_done()
+        logger.debug("next_batch: returning %d items", count)
+        return tensors
+
+    def next_batch_arrays(self, batch_size, dtypes=None):
+        """TPU-first variant: assemble the batch directly into numpy arrays.
+
+        One columnar ``np.asarray`` per tensor instead of a Python list the
+        user must re-stack element-wise; pairs with
+        ``parallel.infeed.ShardedFeed`` for a single per-host device transfer.
+        Returns ``(arrays, count)`` where arrays is an ndarray (no
+        input_mapping) or dict of ndarrays; ``count`` is the number of real
+        rows (may be < batch_size at end of feed).
+        """
+        batch = self.next_batch(batch_size)
+        if self.input_tensors is None:
+            count = len(batch)
+            arr = np.asarray(batch, dtype=dtypes) if count else np.empty((0,))
+            return arr, count
+        count = len(next(iter(batch.values()))) if batch else 0
+        arrays = {
+            tensor: np.asarray(col, dtype=None if dtypes is None else dtypes.get(tensor))
+            for tensor, col in batch.items()
+        }
+        return arrays, count
+
+    def should_stop(self):
+        """True once end-of-feed was observed (reference ``TFNode.py:153-155``)."""
+        return self.done_feeding
+
+    def batch_results(self, results):
+        """Push a batch of inference results to the output queue
+        (reference ``TFNode.py:157-170``)."""
+        queue = self.mgr.get_queue(self.qname_out)
+        for item in results:
+            queue.put(item, block=True)
+
+    def terminate(self):
+        """Terminate data feeding early (e.g. training reached max steps with
+        epochs of data left).  Sets the node state to ``'terminating'`` so
+        upcoming feed partitions are skipped, then drains the input queue
+        (reference ``TFNode.py:172-194``)."""
+        logger.info("terminate() invoked: draining remaining input")
+        self.mgr.set("state", "terminating")
+        queue = self.mgr.get_queue(self.qname_in)
+        count = 0
+        done = False
+        while not done:
+            try:
+                item = queue.get(block=True, timeout=5)
+                queue.task_done()
+                if item is None:
+                    done = True
+                else:
+                    count += 1
+            except _queue.Empty:
+                logger.info("dropped %d items after terminate", count)
+                done = True

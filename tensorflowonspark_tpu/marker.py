@@ -1,0 +1,18 @@
+"""Queue sentinel markers (reference ``marker.py:11-18``).
+
+Items placed in the per-executor data queues alongside real records:
+
+- ``None``            — end-of-feed: no more data will ever arrive (reference
+                        convention, ``TFNode.py:129-134``).
+- ``EndPartition``    — end of one input partition; used by inference feeding so
+                        result batches align with partition boundaries
+                        (reference ``TFSparkNode.py:469``, ``TFNode.py:135-140``).
+"""
+
+
+class Marker(object):
+    """Base class for out-of-band markers placed in data queues."""
+
+
+class EndPartition(Marker):
+    """Marks the end of one input partition within the feed queue."""

@@ -1,0 +1,560 @@
+"""Per-executor node runtime (reference ``TFSparkNode.py``).
+
+The functions here return closures that run as backend tasks on executors:
+
+- :func:`run`       — the "start job" task: claim a role from the cluster
+  template, start the per-executor manager, rendezvous with the driver's
+  reservation server, derive the ``jax.distributed`` coordinates (the
+  TPU-native replacement for building ``TF_CONFIG``,
+  reference ``TFSparkNode.py:264-286``), then invoke the user's
+  ``main_fun(args, ctx)`` in the foreground (FILES-mode workers) or a
+  background process (SPARK-mode workers, ps-like/evaluator roles).
+- :func:`train` / :func:`inference` — "feed job" tasks that push partition
+  data into the node's queues with backpressure (reference
+  ``TFSparkNode.py:371-502``).
+- :func:`shutdown`  — poisons the queues and surfaces late errors
+  (reference ``TFSparkNode.py:505-559``).
+
+Roles (cluster template job names, reference ``TFCluster.py:250-264``):
+``'chief'`` / ``'master'`` (worker 0 with export duties), ``'worker'``,
+``'ps'`` (long-running non-worker role parked on a control queue — kept for
+capability parity even though TPU training is synchronous), ``'evaluator'``.
+"""
+
+import json
+import logging
+import multiprocessing
+import os
+import socket
+import subprocess
+import sys
+import time
+import traceback
+
+from tensorflowonspark_tpu import manager, marker, reservation, util
+
+logger = logging.getLogger(__name__)
+
+# Job names that host a JAX computation and therefore get a process_id in the
+# jax.distributed world (ps parks on a control queue and never runs jax).
+_JAX_JOBS = ("chief", "master", "worker", "evaluator")
+
+# Executor-process-lifetime state (reference "TFSparkNode singleton holder",
+# ``TFSparkNode.py:75-89``): keeps the manager handle referenced after the
+# start task returns — BaseManager shuts its server down when the handle is
+# garbage collected, and the node must outlive the start task in SPARK mode.
+_node_state = {}
+
+
+class TPUNodeContext(object):
+    """Encapsulates a node's identity & helpers, passed to ``main_fun(args, ctx)``.
+
+    Mirrors the reference's ``TFNodeContext`` (``TFSparkNode.py:32-72``) with
+    the TF_CONFIG-era fields replaced by jax.distributed coordinates:
+
+    Attributes:
+      executor_id: backend executor ordinal this node runs on.
+      job_name: ``'chief'|'master'|'worker'|'ps'|'evaluator'``.
+      task_index: index within the job.
+      cluster_info: full sorted roster of node metadata dicts.
+      cluster_spec: ``{job_name: [host:port, ...]}`` view of the roster.
+      default_fs: default filesystem prefix for relative paths.
+      working_dir: this executor's working directory.
+      mgr: connected per-executor manager (queues + state).
+      coordinator_address: ``host:port`` of jax.distributed coordinator
+        (process 0's reserved port).
+      num_processes / process_id: this node's slot in the jax world
+        (``None`` for ps nodes).
+    """
+
+    def __init__(self, executor_id, job_name, task_index, cluster_info,
+                 default_fs, working_dir, mgr, coordinator_address,
+                 num_processes, process_id):
+        self.executor_id = executor_id
+        self.worker_num = executor_id  # reference-compat alias (TFSparkNode.py:34)
+        self.job_name = job_name
+        self.task_index = task_index
+        self.cluster_info = cluster_info
+        self.default_fs = default_fs
+        self.working_dir = working_dir
+        self.mgr = mgr
+        self.coordinator_address = coordinator_address
+        self.num_processes = num_processes
+        self.process_id = process_id
+
+    @property
+    def cluster_spec(self):
+        spec = {}
+        for node in self.cluster_info:
+            spec.setdefault(node["job_name"], []).append(
+                "{}:{}".format(node["host"], node["port"])
+            )
+        return spec
+
+    @property
+    def num_workers(self):
+        """Number of JAX-hosting nodes (reference ``TFSparkNode.py:53``)."""
+        return len([n for n in self.cluster_info if n["job_name"] in _JAX_JOBS])
+
+    def is_chief(self):
+        return self.process_id == 0
+
+    def initialize_distributed(self):
+        """Initialize the multi-host JAX runtime for this node.
+
+        The TPU-native act that replaces consuming ``TF_CONFIG``: every
+        JAX-hosting node calls ``jax.distributed.initialize`` with the
+        coordinates the rendezvous distributed (SURVEY §2.5).  No-op for
+        single-process clusters and for ps nodes.
+        """
+        if self.process_id is None or self.num_processes <= 1:
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+
+    def get_data_feed(self, train_mode=True, qname_in="input",
+                      qname_out="output", input_mapping=None):
+        """Return a :class:`~tensorflowonspark_tpu.datafeed.DataFeed` on this
+        node's queues (reference ``TFNode.py:86``)."""
+        from tensorflowonspark_tpu.datafeed import DataFeed
+
+        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+
+    def absolute_path(self, path):
+        """Normalize a user path against CWD/default_fs (reference ``TFNode.py:23-58``)."""
+        from tensorflowonspark_tpu.datafeed import absolute_path
+
+        return absolute_path(self, path)
+
+
+def _reserve_free_port():
+    """Bind an ephemeral port and hold it (reference ``TFSparkNode.py:239-244``)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("", 0))
+    return s, s.getsockname()[1]
+
+
+def _start_tensorboard(log_dir):
+    """Spawn TensorBoard for this cluster if available (reference
+    ``TFSparkNode.py:199-225``); returns ``(pid, port)`` or ``(0, 0)``."""
+    tb_exec = util.find_in_path(os.environ.get("PATH", ""), "tensorboard")
+    if not tb_exec:
+        logger.warning("tensorboard not found in PATH; skipping launch")
+        return 0, 0
+    sock, tb_port = _reserve_free_port()
+    sock.close()
+    proc = subprocess.Popen(
+        [sys.executable, tb_exec, "--logdir=%s" % log_dir, "--port=%d" % tb_port],
+        env=os.environ,
+    )
+    return proc.pid, tb_port
+
+
+def _sort_key(node):
+    """Deterministic roster ordering: chief/master first, then workers,
+    evaluator, ps — so process_id 0 is always the chief (reference sorts by
+    executor_id, ``TFSparkNode.py:264-276``; we sort by role for a stable
+    jax.distributed process numbering)."""
+    job_rank = {"chief": 0, "master": 0, "worker": 1, "evaluator": 2, "ps": 3}
+    return (job_rank.get(node["job_name"], 4), node["task_index"])
+
+
+def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
+        queues=("input", "output", "error"), background=False,
+        release_port=True):
+    """Build the "start job" task closure (reference ``TFSparkNode.py:121-368``).
+
+    Args:
+      fn: user map function ``fn(args, ctx)``.
+      tf_args: argparse Namespace or argv list passed through to ``fn``.
+      cluster_meta: dict from :func:`tensorflowonspark_tpu.cluster.run` with
+        ``id``, ``cluster_template``, ``server_addr``, ``authkey``,
+        ``default_fs``, ``num_executors``.
+      tensorboard: launch TensorBoard on the chief.
+      background: run ``fn`` in a background process (SPARK input mode), so the
+        executor's task slot frees up for feed jobs (reference
+        ``TFSparkNode.py:310-342``).
+      release_port: close the reserved coordinator port right before invoking
+        ``fn`` (reference ``TFSparkNode.py:306-308``).
+    """
+
+    def _mapfn(iterator):
+        # The start job parallelizes range(num_executors) with one element per
+        # partition; that element is this node's executor id
+        # (reference TFCluster.py:312-316, TFSparkNode.py:148).
+        executor_id = None
+        for item in iterator:
+            executor_id = item
+        assert executor_id is not None, "start task received an empty partition"
+
+        # Claim role from the template (reference TFSparkNode.py:148-158).
+        job_name, task_index = None, -1
+        for job, executors in cluster_meta["cluster_template"].items():
+            if executor_id in executors:
+                job_name = job
+                task_index = executors.index(executor_id)
+                break
+        assert job_name is not None, (
+            "executor_id {} not present in cluster template {}".format(
+                executor_id, cluster_meta["cluster_template"])
+        )
+        logger.info("executor_id=%d assigned role %s:%d", executor_id, job_name, task_index)
+
+        # Stale-node detection: if this working dir already hosts a live node
+        # from another cluster instance, fail loudly so the scheduler retries
+        # elsewhere (reference TFSparkNode.py:166-172).
+        state_file = os.path.join(os.getcwd(), "cluster_state.json")
+        if os.path.exists(state_file):
+            with open(state_file) as f:
+                prior = json.load(f)
+            if prior.get("cluster_id") != cluster_meta["id"] and prior.get("state") == "running":
+                raise Exception(
+                    "A node from cluster {} appears to still be running in {}; "
+                    "this executor cannot host two clusters. Ensure previous "
+                    "clusters were shut down.".format(prior.get("cluster_id"), os.getcwd())
+                )
+
+        util.write_executor_id(executor_id)
+
+        # Start the per-executor manager BEFORE any jax/TPU initialization so
+        # the forked manager server never duplicates a live TPU client
+        # (reference TFSparkNode.py:174-185; remote mode for roles the driver
+        # must reach directly at shutdown, TFCluster.py:186-192).
+        authkey = bytes.fromhex(cluster_meta["authkey"])
+        qnames = list(queues)
+        if job_name in ("ps", "evaluator"):
+            if "control" not in qnames:
+                qnames.append("control")
+            mgr = manager.start(authkey, qnames, mode="remote")
+            addr = list(mgr.address)
+            if not addr[0]:
+                addr[0] = util.get_ip_address()
+        else:
+            mgr = manager.start(authkey, qnames, mode="local")
+            addr = mgr.address  # unix socket path (same-host connections only)
+        mgr.set("state", "running")
+        # Pin the manager handle in the *real* node module of this executor
+        # process — not this closure's globals.  The start-task closure is
+        # cloudpickled by value, so its reconstructed globals (including any
+        # module-level dict captured by value) are garbage collected when the
+        # executor loads its next task; GC of the manager handle would
+        # finalize (kill) the manager server (BaseManager registers a
+        # Finalize).  Importing resolves the genuinely process-global module.
+        import tensorflowonspark_tpu.node as _node_mod
+
+        _node_mod._node_state["mgr"] = mgr
+        _node_mod._node_state["cluster_id"] = cluster_meta["id"]
+        with open(state_file, "w") as f:
+            json.dump({"cluster_id": cluster_meta["id"], "state": "running"}, f)
+
+        # TensorBoard on the first worker-like node (reference TFSparkNode.py:199-225).
+        tb_pid, tb_port = 0, 0
+        if tensorboard and job_name in ("chief", "master", "worker") and task_index == 0:
+            tb_pid, tb_port = _start_tensorboard(log_dir or "tensorboard_logs")
+
+        # Reserve the port this node contributes to the roster.  For process 0
+        # it becomes the jax.distributed coordinator port (reference reserved
+        # the TF gRPC server port here, TFSparkNode.py:239-244).
+        port_sock, port = _reserve_free_port()
+
+        host = util.get_ip_address()
+        client = reservation.Client(cluster_meta["server_addr"])
+        node_meta = {
+            "executor_id": executor_id,
+            "host": host,
+            "job_name": job_name,
+            "task_index": task_index,
+            "port": port,
+            "addr": addr,
+            "authkey": cluster_meta["authkey"],
+            "pid": os.getpid(),
+            "tb_pid": tb_pid,
+            "tb_port": tb_port,
+            "working_dir": os.getcwd(),
+        }
+        client.register(node_meta)
+        cluster_info = client.await_reservations(
+            timeout=cluster_meta.get("reservation_timeout", 600))
+        client.close()
+        cluster_info.sort(key=_sort_key)
+
+        # Duplicate-registration sanity check (reference TFSparkNode.py:267-270).
+        seen = set()
+        for n in cluster_info:
+            key = (n["job_name"], n["task_index"])
+            if key in seen:
+                raise Exception(
+                    "Duplicate cluster node {}; executors likely ran multiple "
+                    "start tasks. Roster: {}".format(key, cluster_info))
+            seen.add(key)
+
+        # Derive jax.distributed coordinates — the TF_CONFIG replacement
+        # (reference TFSparkNode.py:278-286; SURVEY §2.5 mapping).
+        jax_nodes = [n for n in cluster_info if n["job_name"] in _JAX_JOBS]
+        num_processes = len(jax_nodes)
+        process_id = None
+        for i, n in enumerate(jax_nodes):
+            if n["executor_id"] == executor_id:
+                process_id = i
+                break
+        coordinator_address = "{}:{}".format(jax_nodes[0]["host"], jax_nodes[0]["port"])
+
+        ctx = TPUNodeContext(
+            executor_id, job_name, task_index, cluster_info,
+            cluster_meta.get("default_fs", "file://"), os.getcwd(), mgr,
+            coordinator_address, num_processes, process_id,
+        )
+
+        if release_port:
+            port_sock.close()
+
+        def wrapper_fn(args, context):
+            """Invoke the user fn with argv semantics (reference TFSparkNode.py:320-324)."""
+            if isinstance(args, list):
+                sys.argv = args
+            fn(args, context)
+
+        def wrapper_fn_background(args, context):
+            """Background-process wrapper: route exceptions to the error queue
+            (reference TFSparkNode.py:326-332)."""
+            multiprocessing.current_process().authkey = authkey
+            errq = context.mgr.get_queue("error")
+            try:
+                wrapper_fn(args, context)
+            except Exception:
+                errq.put(traceback.format_exc())
+                raise
+
+        if job_name in ("ps", "evaluator") or background:
+            # Run the user fn in a child process; ps/evaluator then park this
+            # task on the control queue so their executor stays reserved
+            # (reference TFSparkNode.py:334-361).  SPARK-mode workers return
+            # immediately, freeing the slot for feed jobs.
+            p = multiprocessing.get_context("fork").Process(
+                target=wrapper_fn_background, args=(tf_args, ctx), daemon=True)
+            p.start()
+            if job_name in ("ps", "evaluator"):
+                ctrl = mgr.get_queue("control")
+                errq = mgr.get_queue("error")
+                done = False
+                while not done:
+                    while not ctrl.empty():
+                        msg = ctrl.get(block=True)
+                        ctrl.task_done()
+                        if msg is None:
+                            done = True
+                    if not errq.empty():
+                        trace = errq.get(block=True)
+                        errq.task_done()
+                        raise Exception(
+                            "Exception in {}:{}:\n{}".format(job_name, task_index, trace))
+                    time.sleep(1)
+                mgr.set("state", "stopped")
+                p.terminate()
+        else:
+            # FILES-mode worker: run inline; the task slot stays occupied for
+            # the duration of training (reference TFSparkNode.py:362-366).
+            errq = mgr.get_queue("error")
+            try:
+                wrapper_fn(tf_args, ctx)
+            except Exception:
+                errq.put(traceback.format_exc())
+                raise
+            finally:
+                mgr.set("state", "finished")
+
+    return _mapfn
+
+
+def _get_manager(cluster_info, host, executor_id):
+    """Reconnect to the manager of the node on (host, executor_id)
+    (reference ``TFSparkNode.py:92-118``)."""
+    for node in cluster_info:
+        if node["host"] == host and node["executor_id"] == executor_id:
+            addr = node["addr"]
+            authkey = bytes.fromhex(node["authkey"])
+            try:
+                m = manager.connect(addr, authkey)
+            except (OSError, EOFError) as e:
+                raise Exception(
+                    "Unable to reach the manager of node {} (role {}:{}) at "
+                    "{!r} (exists={}) from pid {} cwd {!r}: {!r}. The node "
+                    "process may have died; check its logs.".format(
+                        executor_id, node["job_name"], node["task_index"],
+                        addr, os.path.exists(str(addr)), os.getpid(),
+                        os.getcwd(), e))
+            state = m.get("state")
+            logger.debug("connected to manager %s state=%s", addr, state)
+            return m
+    raise Exception(
+        "No cluster node found on executor {} of host {}. A data task was "
+        "scheduled on an executor that is not part of this cluster; ensure "
+        "one task slot per executor and no dynamic allocation.".format(
+            executor_id, host))
+
+
+def train(cluster_info, cluster_meta, qname="input", feed_timeout=600):
+    """Feed-job closure: push partition items into this executor's input queue
+    (reference ``TFSparkNode.py:371-438``)."""
+
+    def _train(iterator):
+        host = util.get_ip_address()
+        executor_id = util.read_executor_id()
+        mgr = _get_manager(cluster_info, host, executor_id)
+        queue = mgr.get_queue(qname)
+        state = mgr.get("state")
+        if state in ("terminating", "stopped"):
+            # Consumer already signalled completion: drain this partition
+            # without feeding (reference TFSparkNode.py:393-399).
+            logger.info("node state %s; skipping partition", state)
+            count = sum(1 for _ in iterator)
+            logger.info("skipped %d items", count)
+        else:
+            count = 0
+            for item in iterator:
+                queue.put(item, block=True)  # backpressure via JoinableQueue
+                count += 1
+            # Wait for the consumer to drain the queue, surfacing user-code
+            # errors and enforcing feed_timeout (reference TFSparkNode.py:407-418).
+            _join_with_error_check(mgr, queue, feed_timeout, "feeding")
+            logger.info("fed %d items to %s queue", count, qname)
+        # If the consumer began terminating while we fed, ask the driver to
+        # stop scheduling feed partitions (reference TFSparkNode.py:422-434).
+        if mgr.get("state") == "terminating":
+            client = reservation.Client(cluster_meta["server_addr"])
+            client.request_stop()
+            client.close()
+        return [count]
+
+    return _train
+
+
+def _join_with_error_check(mgr, queue, timeout, phase):
+    """``queue.join()`` with error-queue polling + timeout (reference
+    ``TFSparkNode.py:407-418``)."""
+    import threading
+
+    joined = threading.Event()
+
+    def _join():
+        queue.join()
+        joined.set()
+
+    t = threading.Thread(target=_join, daemon=True)
+    t.start()
+    deadline = time.time() + timeout
+    errq = mgr.get_queue("error")
+    while not joined.is_set():
+        if not errq.empty():
+            # Peek-and-requeue so later lifecycle checks (shutdown's
+            # late-error pass) still observe the failure (reference
+            # TFSparkNode.py:547-553 applies the same trick).
+            trace = errq.get(block=True)
+            errq.task_done()
+            errq.put(trace)
+            raise Exception("Exception in user code during {}:\n{}".format(phase, trace))
+        if time.time() > deadline:
+            mgr.set("state", "stopped")
+            raise Exception(
+                "Timeout ({}s) waiting for the consumer to drain the {} queue. "
+                "The training process may have exited without consuming its "
+                "data; check executor logs.".format(timeout, phase))
+        time.sleep(0.1)
+
+
+def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
+              feed_timeout=600):
+    """Inference feed-job closure: push one partition, await exactly one result
+    per input item (reference ``TFSparkNode.py:441-502``)."""
+
+    def _inference(iterator):
+        host = util.get_ip_address()
+        executor_id = util.read_executor_id()
+        mgr = _get_manager(cluster_info, host, executor_id)
+        queue_in = mgr.get_queue(qname_in)
+
+        count = 0
+        for item in iterator:
+            queue_in.put(item, block=True)
+            count += 1
+        # Signal end-of-partition so DataFeed can align result batches
+        # (reference TFSparkNode.py:469, marker.py).
+        queue_in.put(marker.EndPartition(), block=True)
+        if count == 0:
+            return []
+        _join_with_error_check(mgr, queue_in, feed_timeout, "inference feeding")
+
+        # Collect exactly `count` results: the 1:1 input/output contract
+        # (reference TFSparkNode.py:491-500, TFNode.py:160-162).
+        queue_out = mgr.get_queue(qname_out)
+        results = []
+        while count > 0:
+            result = queue_out.get(block=True)
+            results.append(result)
+            count -= 1
+            queue_out.task_done()
+        return results
+
+    return _inference
+
+
+def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
+    """Shutdown-job closure: kill TensorBoard, poison the queues, surface late
+    errors (reference ``TFSparkNode.py:505-559``)."""
+
+    def _shutdown(iterator):
+        host = util.get_ip_address()
+        executor_id = util.read_executor_id()
+        mgr = _get_manager(cluster_info, host, executor_id)
+
+        for node in cluster_info:  # kill TB on this node (reference 522-528)
+            if node["host"] == host and node["executor_id"] == executor_id:
+                if node.get("tb_pid"):
+                    try:
+                        os.kill(node["tb_pid"], 15)
+                    except OSError:
+                        pass
+
+        # Poison only the data queues: 'error' must stay clean for the
+        # late-error check below and 'control' is signalled by the driver
+        # (reference TFCluster.py:172-174 passes only data queues here).
+        data_queues = [q for q in queues if q not in ("error", "control")]
+        logger.info("shutting down node %d: poisoning queues %s", executor_id, data_queues)
+        for qname in data_queues:
+            try:
+                queue = mgr.get_queue(qname)
+                queue.put(None, block=True)  # end-of-feed marker (reference 530-540)
+            except (AttributeError, EOFError):
+                pass
+
+        if grace_secs > 0:
+            # Give the chief time to finish exporting (reference 542-545).
+            time.sleep(grace_secs)
+
+        # Late-error check: peek-and-requeue so a retried shutdown task still
+        # sees the failure (reference TFSparkNode.py:547-553).
+        errq = mgr.get_queue("error")
+        if not errq.empty():
+            trace = errq.get(block=True)
+            errq.task_done()
+            errq.put(trace)
+            raise Exception("Exception in user code:\n{}".format(trace))
+
+        mgr.set("state", "stopped")
+        state_file = os.path.join(os.getcwd(), "cluster_state.json")
+        if os.path.exists(state_file):
+            with open(state_file, "w") as f:
+                json.dump({"cluster_id": cluster_meta["id"], "state": "stopped"}, f)
+        # Report which node this task actually reached: scheduling does not
+        # guarantee one task per executor, so the driver retries until every
+        # worker node confirms (poisoning is idempotent — an extra None in a
+        # drained queue is harmless).
+        return [executor_id]
+
+    return _shutdown

@@ -1,0 +1,332 @@
+"""Cluster-bootstrap rendezvous server/client (reference ``reservation.py``).
+
+The driver runs a :class:`Server`; every executor node registers its metadata
+(host, ports, role, manager address) via a :class:`Client`, and all parties
+block until ``count`` reservations have arrived, after which everyone receives
+the full cluster_info list.  The server also carries a "STOP" flag used for
+streaming termination and user-requested early stop (reference
+``reservation.py:128-144``, ``examples/utils/stop_streaming.py``).
+
+Design deltas vs the reference (deliberate, TPU-first):
+
+- Messages are length-prefixed **JSON**, not pickles (reference
+  ``reservation.py:80-94`` pickled arbitrary objects over the wire — an RCE
+  hazard and a cross-language dead end).  Node metadata is restricted to
+  JSON-serializable values; binary authkeys travel hex-encoded.
+- Clients block on the server with a long-poll ``AWAIT`` message instead of
+  reconnecting every second (reference ``reservation.py:261-267`` polled at 1 s
+  granularity); the server answers the moment the roster is complete, so a
+  TPU-pod bring-up doesn't pay a mean 500 ms rendezvous tax per host.
+- The assembled cluster_info is what distributes the
+  ``jax.distributed.initialize(coordinator_address, num_processes, process_id)``
+  parameters to every host (SURVEY §2.5) — the TPU-native replacement for
+  building ``TF_CONFIG``.
+"""
+
+import json
+import logging
+import os
+import select
+import socket
+import struct
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+# Env overrides for multi-homed / NAT'd drivers (reference reservation.py:23-24).
+TFOS_SERVER_HOST = "TFOS_SERVER_HOST"
+TFOS_SERVER_PORT = "TFOS_SERVER_PORT"
+
+_HEADER = struct.Struct(">I")  # 4-byte big-endian length prefix
+
+
+class Reservations(object):
+    """Thread-safe store of node reservations (reference ``reservation.py:29-63``)."""
+
+    def __init__(self, required):
+        self.required = required
+        self._lock = threading.Condition()
+        self._reservations = []
+
+    def add(self, meta):
+        with self._lock:
+            self._reservations.append(meta)
+            if self.done():
+                self._lock.notify_all()
+
+    def done(self):
+        with self._lock:
+            return len(self._reservations) >= self.required
+
+    def get(self):
+        with self._lock:
+            return list(self._reservations)
+
+    def remaining(self):
+        with self._lock:
+            return self.required - len(self._reservations)
+
+    def wait(self, timeout=None):
+        """Block until the roster is complete; returns done-ness."""
+        deadline = None if timeout is None else time.time() + timeout
+        with self._lock:
+            while not self.done():
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._lock.wait(remaining if remaining is not None else 1.0)
+            return True
+
+
+class MessageSocket(object):
+    """Length-prefixed JSON message framing (reference ``reservation.py:66-95``)."""
+
+    def receive(self, sock):
+        header = self._recv_exact(sock, _HEADER.size)
+        (length,) = _HEADER.unpack(header)
+        payload = self._recv_exact(sock, length)
+        return json.loads(payload.decode("utf-8"))
+
+    def send(self, sock, msg):
+        payload = json.dumps(msg).encode("utf-8")
+        sock.sendall(_HEADER.pack(len(payload)) + payload)
+
+    @staticmethod
+    def _recv_exact(sock, n):
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise EOFError("socket closed while receiving message")
+            buf.extend(chunk)
+        return bytes(buf)
+
+
+class Server(MessageSocket):
+    """Driver-side rendezvous server (reference ``reservation.py:98-202``).
+
+    Single listener thread multiplexing all executor connections with
+    ``select``; ``AWAIT`` requests are parked and answered when the roster
+    completes (or a client disconnects and retries).
+    """
+
+    def __init__(self, count):
+        assert count > 0
+        self.reservations = Reservations(count)
+        self.done = False  # set when a STOP was requested (streaming/early-stop)
+        self._stopping = False  # set by stop(): winds the listener down
+        self._socket = None
+        self._thread = None
+
+    def await_reservations(self, status=None, timeout=600):
+        """Block the driver until all nodes registered (reference 111-126).
+
+        ``status`` is a shared dict; if an async job-launcher thread records an
+        ``'error'`` key there, waiting aborts immediately (reference
+        ``reservation.py:117-120`` + ``TFCluster.py:321-323``).
+        """
+        deadline = time.time() + timeout
+        while not self.reservations.done():
+            if status and "error" in status:
+                raise Exception(
+                    "Cluster startup failed on an executor: {}".format(status["error"])
+                )
+            if time.time() > deadline:
+                raise Exception(
+                    "Timed out waiting for cluster reservations after {}s: "
+                    "{} of {} nodes registered. Check executor logs; common causes "
+                    "are insufficient executors or firewalled driver ports.".format(
+                        timeout,
+                        self.reservations.required - self.reservations.remaining(),
+                        self.reservations.required,
+                    )
+                )
+            self.reservations.wait(timeout=1.0)
+            logger.info(
+                "waiting for %d reservations", self.reservations.remaining()
+            )
+        logger.info("all %d reservations completed", self.reservations.required)
+        return self.reservations.get()
+
+    def _handle_message(self, sock, msg, parked):
+        """Dispatch one client message (reference ``reservation.py:128-144``).
+
+        Returns False if the connection should be closed.
+        """
+        mtype = msg.get("type")
+        if mtype == "REG":
+            self.reservations.add(msg["data"])
+            self.send(sock, {"type": "OK"})
+        elif mtype == "QUERY":
+            self.send(sock, {"type": "QUERY", "done": self.reservations.done()})
+        elif mtype == "QINFO":
+            if self.reservations.done():
+                self.send(sock, {"type": "INFO", "data": self.reservations.get()})
+            else:
+                self.send(sock, {"type": "INFO", "data": None})
+        elif mtype == "AWAIT":
+            if self.reservations.done():
+                self.send(sock, {"type": "INFO", "data": self.reservations.get()})
+            elif sock not in parked:
+                parked.append(sock)  # answered when the roster completes
+        elif mtype == "STOP":
+            logger.info("stop requested by client")
+            self.done = True
+            self.send(sock, {"type": "OK"})
+        else:
+            logger.warning("ignoring unknown message type: %r", mtype)
+            self.send(sock, {"type": "ERR", "error": "unknown message type"})
+        return True
+
+    def start(self):
+        """Bind, spawn the daemon listener thread, return ``(host, port)``."""
+        self._socket = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        port = int(os.environ.get(TFOS_SERVER_PORT, 0))
+        self._socket.bind(("", port))
+        self._socket.listen(64)
+        host = os.environ.get(TFOS_SERVER_HOST)
+        if not host:
+            from tensorflowonspark_tpu import util
+
+            host = util.get_ip_address()
+        addr = (host, self._socket.getsockname()[1])
+
+        def _listen():
+            conns = [self._socket]
+            parked = []  # AWAIT connections waiting for roster completion
+            # The listener must keep serving after a STOP message (self.done
+            # only *signals* streaming termination; later feed tasks still
+            # send STOP/QUERY) — only an explicit stop() winds it down.
+            while not self._stopping:
+                try:
+                    readable, _, _ = select.select(conns, [], [], 0.2)
+                except (OSError, ValueError):
+                    break  # listen socket closed by stop()
+                for sock in readable:
+                    if sock is self._socket:
+                        try:
+                            client, _ = sock.accept()
+                        except OSError:
+                            continue  # listen socket closed by stop()
+                        conns.append(client)
+                    else:
+                        try:
+                            msg = self.receive(sock)
+                            keep = self._handle_message(sock, msg, parked)
+                        except (EOFError, OSError, ValueError):
+                            keep = False
+                        if not keep:
+                            conns.remove(sock)
+                            sock.close()
+                if parked and self.reservations.done():
+                    info = self.reservations.get()
+                    for sock in parked:
+                        try:
+                            self.send(sock, {"type": "INFO", "data": info})
+                        except OSError:
+                            pass
+                    parked = []
+
+        self._thread = threading.Thread(
+            target=_listen, name="reservation-server", daemon=True
+        )
+        self._thread.start()
+        logger.info("reservation server listening on %s:%d", addr[0], addr[1])
+        return addr
+
+    def stop(self):
+        """Ask the listener thread to wind down and close the listen socket."""
+        self._stopping = True
+        if self._socket is not None:
+            try:
+                self._socket.close()
+            except OSError:
+                pass
+
+
+class Client(MessageSocket):
+    """Executor-side rendezvous client (reference ``reservation.py:205-272``)."""
+
+    def __init__(self, server_addr, retries=3, retry_delay=1.0):
+        self.server_addr = tuple(server_addr)
+        self._retries = retries
+        self._retry_delay = retry_delay
+        self._sock = self._connect()
+
+    def _connect(self):
+        last = None
+        for attempt in range(self._retries + 1):
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            try:
+                sock.connect(self.server_addr)
+                return sock
+            except OSError as e:  # reference retry-reconnect 227-240
+                last = e
+                sock.close()
+                if attempt < self._retries:
+                    time.sleep(self._retry_delay * (attempt + 1))
+        raise ConnectionError(
+            "Unable to reach reservation server at {}:{}: {}".format(
+                self.server_addr[0], self.server_addr[1], last
+            )
+        )
+
+    def _request(self, msg, timeout=None):
+        self._sock.settimeout(timeout)
+        try:
+            self.send(self._sock, msg)
+            return self.receive(self._sock)
+        finally:
+            self._sock.settimeout(None)
+
+    def register(self, meta):
+        """Register this node's metadata (reference ``reservation.py:251-254``)."""
+        resp = self._request({"type": "REG", "data": meta})
+        assert resp.get("type") == "OK", "registration failed: {}".format(resp)
+
+    def get_reservations(self):
+        """Non-blocking roster query; None until complete."""
+        resp = self._request({"type": "QINFO"})
+        return resp.get("data")
+
+    def await_reservations(self, timeout=600):
+        """Block until the roster is complete; returns cluster_info.
+
+        Long-polls the server (single AWAIT request answered on completion)
+        instead of the reference's 1 s reconnect loop (``reservation.py:261-267``).
+        The AWAIT is sent exactly once; the client then waits on the socket —
+        re-sending would double-park the connection server-side and could
+        desync the message framing on a partial read.
+        """
+        deadline = time.time() + timeout
+        self.send(self._sock, {"type": "AWAIT"})
+        try:
+            while True:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        "Timed out awaiting cluster reservations after {}s".format(
+                            timeout))
+                self._sock.settimeout(min(remaining, 5.0))
+                try:
+                    resp = self.receive(self._sock)
+                except socket.timeout:
+                    continue  # roster still assembling; keep waiting
+                data = resp.get("data")
+                if data is not None:
+                    return data
+        finally:
+            self._sock.settimeout(None)
+
+    def request_stop(self):
+        """Signal STOP (streaming termination / early stop; reference 269-272)."""
+        resp = self._request({"type": "STOP"})
+        assert resp.get("type") == "OK"
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,131 @@
+"""Manager + DataFeed tests (reference ``test/test_TFNode.py``)."""
+
+import pytest
+
+from tensorflowonspark_tpu import manager, marker
+from tensorflowonspark_tpu.datafeed import DataFeed, absolute_path
+
+
+@pytest.fixture
+def mgr():
+    m = manager.start(b"test-authkey", ["input", "output", "error"])
+    yield m
+    m.shutdown()
+
+
+def _feed(m, items, end_of_feed=True):
+    q = m.get_queue("input")
+    for item in items:
+        q.put(item)
+    if end_of_feed:
+        q.put(None)
+
+
+class TestDataFeed:
+    def test_full_and_partial_batches(self, mgr):
+        # Reference test_TFNode.py:27-58 — partial final batch + end-of-feed.
+        _feed(mgr, list(range(10)))
+        feed = DataFeed(mgr)
+        batch = feed.next_batch(4)
+        assert batch == [0, 1, 2, 3]
+        assert not feed.should_stop()
+        assert feed.next_batch(4) == [4, 5, 6, 7]
+        assert feed.next_batch(4) == [8, 9]  # partial: end-of-feed hit
+        assert feed.should_stop()
+
+    def test_end_partition_alignment(self, mgr):
+        q = mgr.get_queue("input")
+        for i in range(3):
+            q.put(i)
+        q.put(marker.EndPartition())
+        for i in range(3, 5):
+            q.put(i)
+        q.put(None)
+        feed = DataFeed(mgr, train_mode=False)
+        # batch stops early at the partition boundary (reference TFNode.py:135-140)
+        assert feed.next_batch(10) == [0, 1, 2]
+        assert feed.next_batch(10) == [3, 4]
+        assert feed.should_stop()
+
+    def test_input_mapping_columns(self, mgr):
+        _feed(mgr, [(1, "a"), (2, "b")])
+        feed = DataFeed(mgr, input_mapping={"col_x": "x", "col_y": "y"})
+        batch = feed.next_batch(2)
+        # columns keyed by tensor name, ordered by sorted column name
+        assert batch == {"x": [1, 2], "y": ["a", "b"]}
+
+    def test_next_batch_arrays(self, mgr):
+        _feed(mgr, [([1.0, 2.0], 3), ([4.0, 5.0], 6)])
+        feed = DataFeed(mgr, input_mapping={"a_features": "x", "b_label": "y"})
+        arrays, count = feed.next_batch_arrays(2)
+        assert count == 2
+        assert arrays["x"].shape == (2, 2)
+        assert arrays["y"].tolist() == [3, 6]
+
+    def test_batch_results_roundtrip(self, mgr):
+        feed = DataFeed(mgr, train_mode=False)
+        feed.batch_results([10, 20, 30])
+        out = mgr.get_queue("output")
+        assert [out.get() for _ in range(3)] == [10, 20, 30]
+
+    def test_terminate_drains(self, mgr):
+        _feed(mgr, list(range(50)))
+        feed = DataFeed(mgr)
+        feed.next_batch(5)
+        feed.terminate()
+        assert mgr.get("state") == "terminating"
+        q = mgr.get_queue("input")
+        assert q.qsize() == 0  # drained through the end-of-feed marker
+
+
+class TestManager:
+    def test_kv_state(self, mgr):
+        mgr.set("state", "running")
+        assert mgr.get("state") == "running"
+
+    def test_connect_local(self, mgr):
+        m2 = manager.connect(mgr.address, b"test-authkey")
+        m2.get_queue("input").put("hello")
+        assert mgr.get_queue("input").get() == "hello"
+
+    def test_remote_mode_tcp(self):
+        m = manager.start(b"remote-key", ["control"], mode="remote")
+        host, port = m.address
+        assert isinstance(port, int) and port > 0
+        m2 = manager.connect(("127.0.0.1", port), b"remote-key")
+        m2.get_queue("control").put(None)
+        assert m.get_queue("control").get() is None
+        m.shutdown()
+
+
+class TestAbsolutePath:
+    """Path normalization matrix (reference ``test/test_TFNode.py:8-25``)."""
+
+    def _ctx(self, default_fs, working_dir="/wd"):
+        return type("MockContext", (), {
+            "default_fs": default_fs, "working_dir": working_dir})()
+
+    def test_schemes_passthrough(self):
+        ctx = self._ctx("file://")
+        for p in ("file:///tmp/x", "hdfs://nn/x", "gs://bucket/x",
+                  "viewfs://cl/x", "s3://b/x"):
+            assert absolute_path(ctx, p) == p
+
+    def test_absolute_local(self):
+        ctx = self._ctx("file://")
+        assert absolute_path(ctx, "/tmp/x") == "file:///tmp/x"
+
+    def test_relative_local_uses_working_dir(self):
+        ctx = self._ctx("file://", working_dir="/wd")
+        assert absolute_path(ctx, "model") == "file:///wd/model"
+
+    def test_relative_hdfs_user_home(self):
+        import getpass
+
+        ctx = self._ctx("hdfs://namenode:8020")
+        assert absolute_path(ctx, "model") == \
+            "hdfs://namenode:8020/user/{}/model".format(getpass.getuser())
+
+    def test_absolute_on_hdfs_fs(self):
+        ctx = self._ctx("hdfs://nn:8020")
+        assert absolute_path(ctx, "/data/x") == "/data/x"

@@ -1,0 +1,134 @@
+"""Rendezvous server/client tests (reference ``test/test_reservation.py``)."""
+
+import os
+import threading
+import time
+from unittest import mock
+
+import pytest
+
+from tensorflowonspark_tpu import reservation
+
+
+def test_reservations_counting():
+    r = reservation.Reservations(3)
+    assert not r.done()
+    assert r.remaining() == 3
+    r.add({"node": 1})
+    r.add({"node": 2})
+    assert not r.done()
+    assert r.remaining() == 1
+    r.add({"node": 3})
+    assert r.done()
+    assert len(r.get()) == 3
+
+
+def test_reservations_wait_timeout():
+    r = reservation.Reservations(1)
+    assert not r.wait(timeout=0.2)
+    r.add({"node": 1})
+    assert r.wait(timeout=0.2)
+
+
+def test_single_client_register_await():
+    server = reservation.Server(1)
+    addr = server.start()
+    client = reservation.Client(addr)
+    meta = {"executor_id": 0, "host": "127.0.0.1", "job_name": "worker",
+            "task_index": 0, "port": 2222}
+    client.register(meta)
+    info = client.await_reservations(timeout=10)
+    assert info == [meta]
+    assert server.reservations.done()
+    client.close()
+    server.stop()
+
+
+def test_query_before_complete():
+    server = reservation.Server(2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    assert client.get_reservations() is None  # roster incomplete
+    client.register({"executor_id": 0})
+    client.register({"executor_id": 1})  # same socket, second node's worth
+    assert len(client.get_reservations()) == 2
+    client.close()
+    server.stop()
+
+
+def test_env_overrides():
+    # Reference test_reservation.py:58-75 — the only env mocking in the suite.
+    with mock.patch.dict(os.environ, {reservation.TFOS_SERVER_HOST: "127.0.0.1"}):
+        server = reservation.Server(1)
+        addr = server.start()
+        assert addr[0] == "127.0.0.1"
+        server.stop()
+
+
+def test_multi_client_threaded_rendezvous():
+    """All clients block in await until the last registers (reference 77-110)."""
+    num = 4
+    server = reservation.Server(num)
+    addr = server.start()
+    results = [None] * num
+
+    def _node(i):
+        client = reservation.Client(addr)
+        client.register({"executor_id": i, "job_name": "worker", "task_index": i})
+        results[i] = client.await_reservations(timeout=15)
+        client.close()
+
+    threads = [threading.Thread(target=_node, args=(i,)) for i in range(num)]
+    for i, t in enumerate(threads):
+        t.start()
+        if i == 0:
+            time.sleep(0.3)  # stagger: first client parks in AWAIT
+    for t in threads:
+        t.join(timeout=20)
+        assert not t.is_alive()
+    for r in results:
+        assert r is not None and len(r) == num
+    server.stop()
+
+
+def test_stop_flag():
+    server = reservation.Server(1)
+    addr = server.start()
+    client = reservation.Client(addr)
+    assert not server.done
+    client.request_stop()
+    assert server.done
+    client.close()
+    server.stop()
+
+
+def test_server_survives_multiple_stops():
+    """Feed tasks may each send STOP after terminate(); the listener must keep
+    serving rather than deadlocking the second sender."""
+    server = reservation.Server(1)
+    addr = server.start()
+    for _ in range(3):
+        c = reservation.Client(addr)
+        c.request_stop()
+        c.close()
+    assert server.done
+    server.stop()
+
+
+def test_await_timeout():
+    server = reservation.Server(2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0})
+    with pytest.raises(TimeoutError):
+        client.await_reservations(timeout=1)
+    client.close()
+    server.stop()
+
+
+def test_server_await_aborts_on_status_error():
+    server = reservation.Server(2)
+    server.start()
+    with pytest.raises(Exception, match="boom"):
+        server.await_reservations(status={"error": "boom"}, timeout=5)
+    server.stop()

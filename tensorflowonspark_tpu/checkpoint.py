@@ -1,0 +1,128 @@
+"""Checkpoint / export conventions (reference SURVEY §5.4).
+
+The reference delegated checkpointing to TF inside ``main_fun`` (Keras
+``ModelCheckpoint``; estimator ``save_checkpoints_steps``) and contributed the
+*conventions*: ``model_dir``/``export_dir`` args, chief-only export
+(reference ``mnist_spark.py:68-72``), shared-storage path normalization, and
+a shutdown grace period so the chief finishes exporting
+(``TFCluster.py:123``, ``TFSparkNode.py:542-545``).
+
+This module implements those conventions over orbax:
+
+- :class:`CheckpointManager` — periodic, retained, atomic checkpoints of any
+  pytree (TrainState), chief-only by default, with restore-latest for
+  mid-training recovery (the reference's recovery story was "Spark retries
+  the job and TF restores from the last checkpoint", SURVEY §5.3).
+- :func:`export_model` / :func:`load_model` — the serving export consumed by
+  the pipeline's model-transform path (reference SavedModel; here an orbax
+  params checkpoint + a JSON descriptor naming the apply function).
+"""
+
+import json
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_DESCRIPTOR = "export.json"
+_PARAMS_DIR = "params"
+
+
+class CheckpointManager(object):
+    """Chief-only periodic checkpointing of a train-state pytree.
+
+    Args:
+      directory: checkpoint root (shared storage in multi-host runs).
+      save_interval_steps: save every N steps (0 = only explicit saves).
+      max_to_keep: retained checkpoints.
+      is_chief: only the chief writes (all hosts may restore); mirrors the
+        reference's chief-only export pattern.
+    """
+
+    def __init__(self, directory, save_interval_steps=100, max_to_keep=3,
+                 is_chief=True):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        self.is_chief = is_chief
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                save_interval_steps=save_interval_steps or 1,
+                max_to_keep=max_to_keep,
+                create=True,
+            ),
+        )
+        self.save_interval_steps = save_interval_steps
+
+    def maybe_save(self, step, state, force=False):
+        """Save if the interval elapsed (chief only); returns True if saved."""
+        if not self.is_chief:
+            return False
+        if not force and (not self.save_interval_steps
+                          or step % self.save_interval_steps != 0):
+            return False  # interval 0 means explicit (force=True) saves only
+        import orbax.checkpoint as ocp
+
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                               force=force)
+        if saved:
+            logger.info("checkpointed step %d to %s", step, self.directory)
+        return saved
+
+    def restore_latest(self, abstract_state):
+        """Restore the newest checkpoint into the structure of
+        ``abstract_state``; returns (state, step) or (None, None)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None, None
+        import orbax.checkpoint as ocp
+
+        state = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state))
+        logger.info("restored checkpoint step %d from %s", step, self.directory)
+        return state, step
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.close()
+
+
+def export_model(export_dir, params, model_name, model_config=None,
+                 input_signature=None):
+    """Export params + model descriptor for serving (chief-only call).
+
+    The pipeline's model-transform path loads this on executors that have the
+    framework's model zoo but no user code — the portability role SavedModel
+    played for the reference (``pipeline.py:474-481``).
+    """
+    import orbax.checkpoint as ocp
+
+    export_dir = os.path.abspath(export_dir)
+    os.makedirs(export_dir, exist_ok=True)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(export_dir, _PARAMS_DIR), params, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+    with open(os.path.join(export_dir, _DESCRIPTOR), "w") as f:
+        json.dump({
+            "model_name": model_name,
+            "model_config": model_config or {},
+            "input_signature": input_signature or {},
+        }, f)
+    logger.info("exported %s to %s", model_name, export_dir)
+
+
+def load_model(export_dir):
+    """Load an export: returns ``(params, descriptor_dict)``."""
+    import orbax.checkpoint as ocp
+
+    export_dir = os.path.abspath(export_dir)
+    with open(os.path.join(export_dir, _DESCRIPTOR)) as f:
+        descriptor = json.load(f)
+    ckptr = ocp.StandardCheckpointer()
+    params = ckptr.restore(os.path.join(export_dir, _PARAMS_DIR))
+    ckptr.close()
+    return params, descriptor

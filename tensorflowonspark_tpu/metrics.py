@@ -1,0 +1,143 @@
+"""Throughput / step-time / MFU instrumentation.
+
+First-class equivalent of the reference's ``TimeHistory`` callback and
+``build_stats`` summary (reference ``examples/resnet/common.py:177-245``):
+per-N-step wall-clock logging, ``avg_exp_per_second``, and final stats —
+plus MFU (model FLOPs utilization), which the BASELINE targets are defined
+in terms of (BASELINE.md: >=50% MFU on v5e-16).
+"""
+
+import json
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+# Peak dense (bf16) FLOPs per chip for MFU accounting.
+PEAK_FLOPS = {
+    "tpu v5 lite": 394e12,   # v5e: 394 TFLOP/s bf16
+    "tpu v5": 459e12,        # v5p
+    "tpu v4": 275e12,
+    "tpu v6 lite": 918e12,   # v6e / trillium
+    "cpu": 1e11,             # nominal figure so tests exercise the math
+}
+
+
+def peak_flops_per_device():
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
+    for key, val in PEAK_FLOPS.items():
+        if kind.startswith(key):
+            return val
+    logger.warning("unknown device kind %r; MFU will be reported as 0", kind)
+    return None
+
+
+def estimate_step_flops(jitted_fn, *args, **kwargs):
+    """FLOPs of one compiled step from XLA's cost analysis (falls back to None)."""
+    try:
+        compiled = jitted_fn.lower(*args, **kwargs).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jax returns [dict]
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        logger.warning("cost analysis unavailable", exc_info=True)
+        return None
+
+
+class TimeHistory(object):
+    """Per-N-step timing + throughput recorder (reference ``common.py:177``).
+
+    Call :meth:`on_step_end` once per global step.  Timestamps of each
+    N-step window land in ``timestamp_log`` exactly like the reference's
+    Keras callback, so ``avg_examples_per_second`` is computed the same way
+    (reference ``common.py:236-244``).
+    """
+
+    def __init__(self, batch_size, log_steps=20, step_flops=None,
+                 num_devices=None):
+        import jax
+
+        self.batch_size = batch_size
+        self.log_steps = log_steps
+        self.step_flops = step_flops  # whole-step FLOPs across all devices
+        self.num_devices = num_devices or len(jax.devices())
+        self.global_steps = 0
+        self.timestamp_log = []
+        self.train_start_time = None
+        self.start_time = None
+        self.elapsed = 0.0
+
+    def on_train_begin(self):
+        self.train_start_time = time.time()
+        self.start_time = time.time()
+        self.timestamp_log.append((0, self.start_time))
+
+    def on_step_end(self):
+        if self.train_start_time is None:
+            self.on_train_begin()
+        self.global_steps += 1
+        if self.global_steps % self.log_steps == 0:
+            now = time.time()
+            elapsed = now - self.start_time
+            eps = self.batch_size * self.log_steps / elapsed
+            msg = ("step %d: %.1f examples/sec (%.1f/sec/chip), "
+                   "%.1f ms/step" % (
+                       self.global_steps, eps, eps / self.num_devices,
+                       1000 * elapsed / self.log_steps))
+            mfu = self.mfu(elapsed / self.log_steps)
+            if mfu is not None:
+                msg += ", %.1f%% MFU" % (100 * mfu)
+            logger.info(msg)
+            self.timestamp_log.append((self.global_steps, now))
+            self.start_time = now
+
+    def on_train_end(self):
+        self.elapsed = time.time() - self.train_start_time
+
+    def mfu(self, step_seconds):
+        peak = peak_flops_per_device()
+        if peak is None or not self.step_flops or step_seconds <= 0:
+            return None
+        return self.step_flops / (peak * self.num_devices) / step_seconds
+
+    # -- summary (reference build_stats, common.py:202-245) ---------------
+
+    def avg_examples_per_second(self):
+        log = self.timestamp_log
+        if len(log) < 2:
+            return 0.0
+        steps = log[-1][0] - log[0][0]
+        elapsed = log[-1][1] - log[0][1]
+        return self.batch_size * steps / elapsed if elapsed > 0 else 0.0
+
+    def build_stats(self, loss=None, eval_loss=None, accuracy=None):
+        eps = self.avg_examples_per_second()
+        stats = {
+            "global_steps": self.global_steps,
+            "avg_exp_per_second": eps,
+            "exp_per_second_per_chip": eps / self.num_devices,
+            "train_finish_time": time.time(),
+            "elapsed_seconds": self.elapsed,
+        }
+        avg_step = (self.elapsed / self.global_steps
+                    if self.global_steps and self.elapsed else None)
+        if avg_step:
+            stats["avg_step_seconds"] = avg_step
+            mfu = self.mfu(avg_step)
+            if mfu is not None:
+                stats["mfu"] = mfu
+        if loss is not None:
+            stats["loss"] = float(loss)
+        if eval_loss is not None:
+            stats["eval_loss"] = float(eval_loss)
+        if accuracy is not None:
+            stats["accuracy_top_1"] = float(accuracy)
+        return stats
+
+    def log_stats(self, **kwargs):
+        stats = self.build_stats(**kwargs)
+        logger.info("train stats: %s", json.dumps(stats, default=float))
+        return stats

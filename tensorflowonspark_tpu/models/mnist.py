@@ -1,0 +1,59 @@
+"""MNIST CNN (reference ``examples/mnist/keras/mnist_spark.py:14-20``).
+
+Same topology as the reference's Keras model — Conv(32,3x3)/ReLU, MaxPool,
+Flatten, Dense(128? no: the reference uses Conv+Pool then Dense(10)) — kept
+deliberately small and MXU-friendly: convs in NHWC, bf16-capable, static
+shapes.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import register_model
+
+
+class MnistCNN(nn.Module):
+    """Conv(32)->pool->Conv(64)->pool->Dense(128)->Dense(10), the reference's
+    example CNN family (``mnist_spark.py:14-20`` uses Conv/MaxPool/Flatten/
+    Dense(10); the estimator variant adds the second conv block,
+    ``examples/mnist/estimator/mnist_spark.py:31-43``)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [batch, 28, 28, 1] floats in [0, 1]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+@register_model("mnist_cnn")
+def build_mnist(num_classes=10, dtype="float32"):
+    return MnistCNN(num_classes=num_classes, dtype=jnp.dtype(dtype))
+
+
+def loss_fn(model):
+    """Masked softmax cross-entropy loss for the Trainer contract."""
+    import optax
+
+    def loss(params, batch, mask):
+        logits = model.apply({"params": params}, batch["image"])
+        labels = batch["label"].astype(jnp.int32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        ce = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        acc = (((logits.argmax(-1) == labels) * mask).sum()
+               / jnp.maximum(mask.sum(), 1.0))
+        return ce, {"accuracy": acc, "logits": logits}
+
+    return loss

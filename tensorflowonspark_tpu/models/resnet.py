@@ -1,0 +1,160 @@
+"""ResNet family: ResNet50-v1.5 (ImageNet) and ResNet56 (CIFAR-10).
+
+Capability parity with the reference's resnet example models
+(``examples/resnet/resnet_model.py`` — ResNet50 v1.5 with the stride-2 in the
+3x3 of each bottleneck; ``examples/resnet/resnet_cifar_model.py`` — the
+6n+2-layer CIFAR ResNet with basic blocks), rebuilt in flax for TPU:
+
+- NHWC layouts and bf16 compute dtype keep convs on the MXU;
+- BatchNorm carries explicit ``batch_stats`` collections (functional state);
+- no data-dependent Python control flow — the whole model jits statically.
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import register_model
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """ResNet-v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1 with projection
+    shortcut (stride placement per reference ``resnet_model.py`` v1.5)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                      use_bias=False)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 strides=(self.strides,) * 2,
+                                 use_bias=False)(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """CIFAR basic block (two 3x3 convs; reference ``resnet_cifar_model.py``)."""
+
+    filters: int
+    strides: int = 1
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                      use_bias=False)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), use_bias=False)(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 strides=(self.strides,) * 2,
+                                 use_bias=False)(residual)
+            residual = self.norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet.
+
+    ``stage_sizes``/``block_cls`` select the variant: [3,4,6,3] bottleneck =
+    ResNet50 v1.5; [9,9,9] basic = ResNet56 for CIFAR.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: type = BottleneckBlock
+    num_classes: int = 1000
+    num_filters: int = 64
+    cifar_stem: bool = False   # 3x3 stem, no max-pool (CIFAR variant)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        conv = partial(nn.Conv, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        if self.cifar_stem:
+            x = conv(self.num_filters, (3, 3), use_bias=False)(x)
+            x = norm()(x)
+            x = nn.relu(x)
+        else:
+            x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                     use_bias=False)(x)
+            x = norm()(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = self.block_cls(self.num_filters * 2 ** i,
+                                   strides=strides, conv=conv, norm=norm)(x)
+        x = x.mean(axis=(1, 2))  # global average pool
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+@register_model("resnet50")
+def build_resnet50(num_classes=1000, dtype="bfloat16"):
+    """ResNet50 v1.5 for ImageNet (reference ``resnet_imagenet_main.py``)."""
+    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
+                  num_classes=num_classes, dtype=jnp.dtype(dtype))
+
+
+@register_model("resnet56_cifar")
+def build_resnet56(num_classes=10, dtype="float32"):
+    """ResNet56 for CIFAR-10 (reference ``resnet_cifar_main.py``)."""
+    return ResNet(stage_sizes=[9, 9, 9], block_cls=BasicBlock,
+                  num_classes=num_classes, num_filters=16, cifar_stem=True,
+                  dtype=jnp.dtype(dtype))
+
+
+def loss_fn(model, weight_decay=0.0, label_smoothing=0.0):
+    """Masked cross-entropy (+L2) for the Trainer's extra-state contract:
+    ``loss(params, batch_stats, batch, mask)``; updated BatchNorm statistics
+    return via ``aux["extra_state"]`` (never optimized)."""
+    import jax
+    import optax
+
+    def loss(params, batch_stats, batch, mask):
+        logits, new_state = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            batch["image"], train=True, mutable=["batch_stats"])
+        labels = batch["label"].astype(jnp.int32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels) if label_smoothing == 0.0 else \
+            optax.softmax_cross_entropy(
+                logits, optax.smooth_labels(
+                    jax.nn.one_hot(labels, logits.shape[-1]),
+                    label_smoothing))
+        ce = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        if weight_decay:
+            l2 = sum(jnp.sum(p ** 2) for p in
+                     jax.tree_util.tree_leaves(params) if p.ndim > 1)
+            ce = ce + weight_decay * l2
+        acc = (((logits.argmax(-1) == labels) * mask).sum()
+               / jnp.maximum(mask.sum(), 1.0))
+        return ce, {"accuracy": acc, "extra_state": new_state["batch_stats"]}
+
+    return loss

@@ -1,0 +1,128 @@
+"""Decoder-only transformer LM with mesh-parallel attention.
+
+The reference framework predates attention entirely (SURVEY §5.7); this model
+is the long-context showcase of the TPU-native design: the same module runs
+
+- ``attention="full"``     — plain causal attention (single device / small S),
+- ``attention="ring"``     — ring attention over the mesh's ``"seq"`` axis
+  (sequence parallelism; see :mod:`tensorflowonspark_tpu.parallel.ring`),
+- ``attention="ulysses"``  — all-to-all head-parallel attention.
+
+Everything is static-shaped and bf16-friendly; the attention choice only
+swaps the core contraction, so checkpoints are interchangeable between modes
+(e.g. train with ring on a pod, serve with full on one chip).
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import register_model
+from tensorflowonspark_tpu.parallel import ring
+
+
+class Attention(nn.Module):
+    num_heads: int
+    head_dim: int
+    attention: str = "full"   # full | ring | ulysses
+    mesh: Optional[object] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        features = self.num_heads * self.head_dim
+        qkv = nn.DenseGeneral((3, self.num_heads, self.head_dim),
+                              dtype=self.dtype, name="qkv")(x)
+        q, k, v = (qkv[:, :, i] for i in range(3))
+        if self.attention == "ring":
+            assert self.mesh is not None, "ring attention needs a mesh"
+            out = ring.ring_attention(q, k, v, self.mesh, causal=True)
+        elif self.attention == "ulysses":
+            assert self.mesh is not None, "ulysses attention needs a mesh"
+            out = ring.ulysses_attention(q, k, v, self.mesh, causal=True)
+        else:
+            out = ring.reference_attention(q, k, v, causal=True)
+        out = out.reshape(out.shape[0], out.shape[1], features)
+        return nn.Dense(x.shape[-1], dtype=self.dtype, name="proj")(out)
+
+
+class Block(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    attention: str = "full"
+    mesh: Optional[object] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        x = x + Attention(self.num_heads, self.head_dim, self.attention,
+                          self.mesh, self.dtype)(h)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(x.shape[-1] * self.mlp_ratio, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    head_dim: int = 64
+    max_seq_len: int = 2048
+    attention: str = "full"
+    mesh: Optional[object] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        d_model = self.num_heads * self.head_dim
+        x = nn.Embed(self.vocab_size, d_model, dtype=self.dtype,
+                     name="embed")(tokens)
+        pos = nn.Embed(self.max_seq_len, d_model, dtype=self.dtype,
+                       name="pos_embed")(jnp.arange(tokens.shape[1]))
+        x = x + pos[None]
+        for i in range(self.num_layers):
+            x = Block(self.num_heads, self.head_dim,
+                      attention=self.attention, mesh=self.mesh,
+                      dtype=self.dtype, name="block_%d" % i)(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        # weight-tied readout keeps the big vocab matmul on the MXU once
+        embed = self.variables["params"]["embed"]["embedding"]
+        return (x @ embed.T.astype(self.dtype)).astype(jnp.float32)
+
+
+@register_model("transformer_lm")
+def build_transformer(vocab_size=32000, num_layers=4, num_heads=8,
+                      head_dim=64, max_seq_len=2048, attention="full",
+                      mesh=None, dtype="float32"):
+    return TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
+                         num_heads=num_heads, head_dim=head_dim,
+                         max_seq_len=max_seq_len, attention=attention,
+                         mesh=mesh, dtype=jnp.dtype(dtype))
+
+
+def loss_fn(model):
+    """Next-token cross-entropy with per-row masking.
+
+    The model is applied to the *full* sequence (not ``tokens[:, :-1]``) so
+    the sequence length stays divisible by the mesh's ``seq`` axis for
+    ring/ulysses attention; the last position, which has no target, is
+    excluded via a position mask instead.
+    """
+    import optax
+
+    def loss(params, batch, mask):
+        tokens = batch["tokens"].astype(jnp.int32)
+        logits = model.apply({"params": params}, tokens)      # [B, S, V]
+        targets = jnp.roll(tokens, -1, axis=1)                # last pos junk
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        pos_mask = jnp.ones(tokens.shape[1]).at[-1].set(0.0)  # drop last pos
+        ce = (ce * pos_mask[None]).sum(axis=-1) / pos_mask.sum()
+        ce = (ce * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return ce, {}
+
+    return loss

@@ -1,0 +1,21 @@
+"""TPU-native parallelism layer: device meshes, collectives, sharded infeed,
+and sequence parallelism.
+
+This package is the data plane the reference delegated to TensorFlow's gRPC
+servers and NCCL collectives via ``TF_CONFIG`` (reference
+``TFSparkNode.py:278-286``, SURVEY §2.5): here it is expressed as
+``jax.sharding.Mesh`` axes + XLA collectives over ICI/DCN, with host data
+entering through per-host batched infeed instead of element-at-a-time queue
+hops (the reference's InputMode.SPARK bottleneck, SURVEY §3.2).
+"""
+
+from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    batch_sharding,
+    replicated,
+)
+from tensorflowonspark_tpu.parallel.collectives import (  # noqa: F401
+    all_hosts_agree,
+    end_of_data_consensus,
+)

@@ -1,0 +1,37 @@
+"""Small cross-device/cross-host collective helpers.
+
+The headline one is :func:`end_of_data_consensus` — the exact fix for the
+reference's fragile uneven-partition handling: the reference told users to
+train on "90% of the steps" so no worker starved at epoch end
+(reference ``examples/mnist/keras/mnist_spark.py:58-66``); here all hosts
+agree on every step whether a full global batch exists, via a tiny allreduce
+that rides ICI (SURVEY §7.4.1).
+"""
+
+
+def all_hosts_agree(mesh, local_flag):
+    """Global logical-AND of a per-host boolean over the whole mesh.
+
+    Returns a Python bool: True iff every process passed True.  Implemented as
+    a min-allreduce of a one-element array through jit so it lowers to an XLA
+    collective, not host RPC.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if jax.process_count() == 1:
+        return bool(local_flag)
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        jnp.asarray(bool(local_flag), dtype=jnp.int32))
+    return bool(flags.min())
+
+
+def end_of_data_consensus(mesh, local_has_data):
+    """True iff *every* host still has data for the next step.
+
+    Call once per step in SPARK input mode; when any host's feed is exhausted
+    all hosts stop together, keeping the SPMD mesh in lock-step (replaces the
+    reference's 90%-of-steps workaround)."""
+    return all_hosts_agree(mesh, local_has_data)

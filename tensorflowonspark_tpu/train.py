@@ -1,0 +1,151 @@
+"""Trainer: mesh-sharded pjit training loop over flax models.
+
+The reference delegated "the math" to TF inside the user fn (strategy scope +
+``model.fit``, e.g. ``examples/mnist/keras/mnist_spark.py:11-66``); users of
+this framework can do the same with raw jax — but this module is the batteries
+-included path: it owns the train_step (donated state, bf16 compute, grads
+allreduced implicitly by sharded batch + replicated params), the metrics
+(:mod:`~tensorflowonspark_tpu.metrics`), and end-of-data consensus when fed
+from Spark partitions.
+"""
+
+import dataclasses
+import logging
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu import metrics as metrics_mod
+from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class TrainState:
+    """Minimal functional train state: trainable params + optimizer state +
+    step + non-trainable collections (e.g. BatchNorm ``batch_stats``)."""
+
+    step: Any
+    params: Any
+    opt_state: Any
+    extra: Any = None
+
+    def tree_flatten(self):
+        return (self.step, self.params, self.opt_state, self.extra), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
+
+
+class Trainer(object):
+    """Builds and runs a sharded training step.
+
+    Args:
+      loss_fn: ``fn(params, batch, mask) -> (loss, aux)`` — or, when
+        ``extra_state`` is given, ``fn(params, extra, batch, mask)`` where
+        ``extra`` carries non-trainable collections (BatchNorm stats); the
+        updated collections are returned in ``aux["extra_state"]``.  ``mask``
+        is the per-row validity mask from the infeed (1.0 = real row) and
+        must be applied by the loss so padded rows contribute nothing.
+      init_params: parameter pytree (replicated over the mesh).
+      extra_state: initial non-trainable state pytree (not optimized).
+      optimizer: an optax GradientTransformation.
+      mesh: device mesh (defaults to a pure data-parallel mesh).
+      compute_dtype: cast batch inputs to this dtype inside the step (bf16 by
+        default on TPU: keeps matmuls on the MXU's native precision while
+        params/optimizer state stay fp32).
+      batch_size: global batch size (for throughput metrics).
+      log_steps: TimeHistory window.
+    """
+
+    def __init__(self, loss_fn, init_params, optimizer, mesh=None,
+                 extra_state=None, compute_dtype=None, batch_size=None,
+                 log_steps=20, donate=True):
+        self.mesh = mesh if mesh is not None else mesh_mod.build_mesh()
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.compute_dtype = compute_dtype
+        self.batch_size = batch_size
+        self.log_steps = log_steps
+        self._has_extra = extra_state is not None
+
+        replicated = mesh_mod.replicated(self.mesh)
+        self.state = TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=init_params,
+            opt_state=optimizer.init(init_params),
+            extra=extra_state,
+        )
+        self.state = jax.device_put(self.state, replicated)
+
+        def train_step(state, batch, mask):
+            if self.compute_dtype is not None:
+                batch = jax.tree_util.tree_map(
+                    lambda x: x.astype(self.compute_dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, batch)
+            if self._has_extra:
+                def wrapped(params):
+                    return self.loss_fn(params, state.extra, batch, mask)
+            else:
+                def wrapped(params):
+                    return self.loss_fn(params, batch, mask)
+            (loss, aux), grads = jax.value_and_grad(
+                wrapped, has_aux=True)(state.params)
+            updates, new_opt = self.optimizer.update(
+                grads, state.opt_state, state.params)
+            import optax
+
+            new_params = optax.apply_updates(state.params, updates)
+            new_extra = state.extra
+            if self._has_extra and isinstance(aux, dict) and "extra_state" in aux:
+                new_extra = aux["extra_state"]
+            return (TrainState(state.step + 1, new_params, new_opt, new_extra),
+                    loss, aux)
+
+        self._train_step = jax.jit(
+            train_step, donate_argnums=(0,) if donate else ())
+        self.history = None
+
+    def compile_and_measure(self, example_batch, example_mask):
+        """Lower/compile once and capture per-step FLOPs for MFU reporting."""
+        flops = metrics_mod.estimate_step_flops(
+            self._train_step, self.state, example_batch, example_mask)
+        self.history = metrics_mod.TimeHistory(
+            batch_size=self.batch_size or 0, log_steps=self.log_steps,
+            step_flops=flops)
+        return flops
+
+    def step(self, batch, mask=None):
+        """Run one global step; returns (loss, aux)."""
+        if mask is None:
+            first = jax.tree_util.tree_leaves(batch)[0]
+            mask = jnp.ones((first.shape[0],), jnp.float32)
+        if self.history is None:
+            self.compile_and_measure(batch, mask)
+            self.history.on_train_begin()
+        self.state, loss, aux = self._train_step(self.state, batch, mask)
+        self.history.on_step_end()
+        return loss, aux
+
+    def fit_feed(self, sharded_feed, max_steps=None):
+        """Train from a :class:`~tensorflowonspark_tpu.parallel.infeed.ShardedFeed`
+        until end-of-data consensus (or ``max_steps``); returns final stats."""
+        last_loss = None
+        for batch, mask in sharded_feed.batches():
+            loss, _ = self.step(batch, mask)
+            last_loss = loss
+            if max_steps and int(self.state.step) >= max_steps:
+                break
+        if self.history:
+            self.history.on_train_end()
+            return self.history.log_stats(
+                loss=None if last_loss is None else float(last_loss))
+        return {}

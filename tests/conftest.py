@@ -1,22 +1,29 @@
 """Test harness configuration.
 
-Forces JAX onto a virtual 8-device CPU platform *before any jax import*, so
+Forces JAX onto a virtual 8-device CPU platform *before any backend init*, so
 multi-chip sharding logic is exercised without TPU hardware — the TPU-native
 equivalent of the reference's local Spark Standalone test rig
 (reference ``test/run_tests.sh:15-22``, ``test/README.md:10``): multiple
 executor processes on one machine behave like multiple hosts.
 
-Child executor processes inherit this environment, so nodes spawned by
-LocalBackend also run on the virtual CPU mesh.
+Two layers of override are needed because the hosting image may install a TPU
+PJRT plugin via sitecustomize that prepends itself to ``jax_platforms``:
+
+- this process: ``jax.config.update`` after import beats the plugin hook;
+- executor child processes (fresh interpreters): clearing the plugin's
+  activation env var plus ``JAX_PLATFORMS=cpu`` keeps them on CPU.
 """
 
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # de-activate TPU plugin hook in children
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-# Keep XLA's compilation single-threaded-friendly on small CI machines.
-os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+
+import jax  # noqa: E402  (must import after the env staging above)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,119 @@
+"""Trainer / metrics / checkpoint tests (CPU mesh)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensorflowonspark_tpu import checkpoint as ckpt_mod
+from tensorflowonspark_tpu import metrics as metrics_mod
+from tensorflowonspark_tpu.train import Trainer
+from tensorflowonspark_tpu.parallel import build_mesh, batch_sharding
+
+
+def _linear_loss(params, batch, mask):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = (pred - batch["y"]) ** 2 * mask
+    return err.sum() / jnp.maximum(mask.sum(), 1.0), pred
+
+
+TRUE_W = np.array([3.14, 1.618], dtype=np.float32)  # reference test weights
+                                                    # (test_pipeline.py:17-25)
+
+
+def _make_batch(mesh, n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 2).astype(np.float32)
+    y = x @ TRUE_W
+    sharding = batch_sharding(mesh)
+    return {"x": jax.device_put(x, sharding), "y": jax.device_put(y, sharding)}
+
+
+class TestTrainer:
+    def test_converges_to_known_weights(self):
+        mesh = build_mesh()
+        params = {"w": jnp.zeros((2,)), "b": jnp.zeros(())}
+        tr = Trainer(_linear_loss, params, optax.adam(0.1), mesh=mesh,
+                     batch_size=64, log_steps=50)
+        for step in range(300):
+            loss, _ = tr.step(_make_batch(mesh, seed=step))
+        assert float(loss) < 1e-3
+        w = np.asarray(tr.state.params["w"])
+        np.testing.assert_allclose(w, TRUE_W, atol=0.05)
+
+    def test_mask_excludes_padded_rows(self):
+        mesh = build_mesh()
+        params = {"w": jnp.zeros((2,)), "b": jnp.zeros(())}
+        tr = Trainer(_linear_loss, params, optax.sgd(0.0), mesh=mesh)
+        batch = _make_batch(mesh)
+        # poison the padded rows: with a correct mask they cannot affect loss
+        y = np.asarray(batch["y"]).copy()
+        y[32:] = 1e6
+        batch["y"] = jax.device_put(y, batch["x"].sharding)
+        mask = np.zeros((64,), np.float32)
+        mask[:32] = 1.0
+        loss_masked, _ = tr.step(batch, jax.device_put(mask, batch["x"].sharding))
+        assert float(loss_masked) < 1e3
+
+
+class TestMetrics:
+    def test_time_history_throughput(self):
+        th = metrics_mod.TimeHistory(batch_size=32, log_steps=2,
+                                     step_flops=1e6, num_devices=8)
+        th.on_train_begin()
+        for _ in range(6):
+            th.on_step_end()
+        th.on_train_end()
+        stats = th.build_stats(loss=0.5)
+        assert stats["global_steps"] == 6
+        assert stats["avg_exp_per_second"] > 0
+        assert stats["loss"] == 0.5
+        assert "mfu" in stats  # cpu has a nominal peak-flops entry
+
+    def test_step_flops_from_cost_analysis(self):
+        f = jax.jit(lambda a, b: a @ b)
+        x = jnp.ones((64, 64))
+        flops = metrics_mod.estimate_step_flops(f, x, x)
+        assert flops and flops >= 2 * 64 * 64 * 64 * 0.9
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        state = {"w": jnp.arange(4.0), "step": jnp.asarray(7)}
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "ckpt"),
+                                         save_interval_steps=2)
+        assert not mgr.maybe_save(1, state)   # off-interval
+        assert mgr.maybe_save(2, state)
+        mgr.wait_until_finished()
+        abstract = jax.tree_util.tree_map(np.zeros_like, state)
+        restored, step = mgr.restore_latest(abstract)
+        assert step == 2
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(4.0))
+        mgr.close()
+
+    def test_interval_zero_means_explicit_saves_only(self, tmp_path):
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c0"),
+                                         save_interval_steps=0)
+        assert not mgr.maybe_save(1, {"a": jnp.ones(1)})
+        assert mgr.maybe_save(1, {"a": jnp.ones(1)}, force=True)
+        mgr.close()
+
+    def test_non_chief_never_writes(self, tmp_path):
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c2"), is_chief=False)
+        assert not mgr.maybe_save(100, {"a": jnp.ones(1)}, force=True)
+        mgr.close()
+
+    def test_export_load_model(self, tmp_path):
+        params = {"dense": {"kernel": jnp.ones((2, 3))}}
+        ckpt_mod.export_model(str(tmp_path / "exp"), params, "mnist_cnn",
+                              model_config={"num_classes": 10})
+        loaded, desc = ckpt_mod.load_model(str(tmp_path / "exp"))
+        assert desc["model_name"] == "mnist_cnn"
+        assert desc["model_config"]["num_classes"] == 10
+        np.testing.assert_array_equal(
+            np.asarray(loaded["dense"]["kernel"]), np.ones((2, 3)))

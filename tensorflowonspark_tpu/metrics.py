@@ -35,7 +35,12 @@ def peak_flops_per_device():
 
 
 def estimate_step_flops(jitted_fn, *args, **kwargs):
-    """FLOPs of one compiled step from XLA's cost analysis (falls back to None)."""
+    """Per-device FLOPs of one compiled step from XLA's cost analysis
+    (falls back to None).
+
+    XLA reports the cost of the post-SPMD-partitioning per-device module, so
+    on an N-device mesh this is ~1/N of the global step FLOPs — pair it with
+    the per-device peak (see :meth:`TimeHistory.mfu`)."""
     try:
         compiled = jitted_fn.lower(*args, **kwargs).compile()
         cost = compiled.cost_analysis()
@@ -62,7 +67,7 @@ class TimeHistory(object):
 
         self.batch_size = batch_size
         self.log_steps = log_steps
-        self.step_flops = step_flops  # whole-step FLOPs across all devices
+        self.step_flops = step_flops  # per-device FLOPs (post-partitioning)
         self.num_devices = num_devices or len(jax.devices())
         self.global_steps = 0
         self.timestamp_log = []
@@ -98,10 +103,12 @@ class TimeHistory(object):
         self.elapsed = time.time() - self.train_start_time
 
     def mfu(self, step_seconds):
+        # step_flops and peak are both per-device figures (XLA cost analysis
+        # reports the partitioned per-device module), so no num_devices term.
         peak = peak_flops_per_device()
         if peak is None or not self.step_flops or step_seconds <= 0:
             return None
-        return self.step_flops / (peak * self.num_devices) / step_seconds
+        return self.step_flops / peak / step_seconds
 
     # -- summary (reference build_stats, common.py:202-245) ---------------
 

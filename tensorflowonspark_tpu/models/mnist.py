@@ -1,9 +1,8 @@
 """MNIST CNN (reference ``examples/mnist/keras/mnist_spark.py:14-20``).
 
-Same topology as the reference's Keras model — Conv(32,3x3)/ReLU, MaxPool,
-Flatten, Dense(128? no: the reference uses Conv+Pool then Dense(10)) — kept
-deliberately small and MXU-friendly: convs in NHWC, bf16-capable, static
-shapes.
+The reference's example CNN family (see class docstring for the exact
+topology mapping), kept deliberately small and MXU-friendly: convs in NHWC,
+bf16-capable, static shapes.
 """
 
 import flax.linen as nn

@@ -1,21 +1,25 @@
-"""Small cross-device/cross-host collective helpers.
+"""Small cross-host agreement helpers for the SPARK-mode feed path.
 
 The headline one is :func:`end_of_data_consensus` — the exact fix for the
 reference's fragile uneven-partition handling: the reference told users to
 train on "90% of the steps" so no worker starved at epoch end
 (reference ``examples/mnist/keras/mnist_spark.py:58-66``); here all hosts
-agree on every step whether a full global batch exists, via a tiny allreduce
-that rides ICI (SURVEY §7.4.1).
+agree on every step whether a full global batch exists (SURVEY §7.4.1).
+
+Implementation note: this is a **host-level** allgather over the
+``jax.distributed`` runtime (one small cross-host RPC per step, overlapped
+with infeed prefetch) — not a device collective.  The flag is born on the
+host (did my queue yield rows?), so a device-side allreduce would pay a
+host→device→host round trip per step for no win; the gradient allreduce
+riding ICI is what keeps the step itself device-bound.
 """
 
 
-def all_hosts_agree(mesh, local_flag):
-    """Global logical-AND of a per-host boolean over the whole mesh.
-
-    Returns a Python bool: True iff every process passed True.  Implemented as
-    a min-allreduce of a one-element array through jit so it lowers to an XLA
-    collective, not host RPC.
-    """
+def all_hosts_agree(local_flag, mesh=None):
+    """Global logical-AND of a per-host boolean; True iff every process
+    passed True.  ``mesh`` is unused today (host-level implementation, see
+    module docstring) and accepted for a future device-collective path."""
+    del mesh
     import jax
     import jax.numpy as jnp
 
@@ -34,4 +38,4 @@ def end_of_data_consensus(mesh, local_has_data):
     Call once per step in SPARK input mode; when any host's feed is exhausted
     all hosts stop together, keeping the SPMD mesh in lock-step (replaces the
     reference's 90%-of-steps workaround)."""
-    return all_hosts_agree(mesh, local_has_data)
+    return all_hosts_agree(local_has_data, mesh)

@@ -62,13 +62,16 @@ class ShardedFeed(object):
     def _next_local(self):
         """Assemble this host's local rows; returns (arrays, count) or None
         when no usable rows remain."""
-        items = self.feed.next_batch(self.local_batch_size)
-        if isinstance(items, dict):
-            count = len(next(iter(items.values()))) if items else 0
+        if self.preprocess is not None:
+            # user preprocess consumes the raw item lists
+            items = self.feed.next_batch(self.local_batch_size)
+            if isinstance(items, dict):
+                count = len(next(iter(items.values()))) if items else 0
+            else:
+                count = len(items)
             arrays = items
         else:
-            count = len(items)
-            arrays = items
+            arrays, count = self.feed.next_batch_arrays(self.local_batch_size)
         if count == 0:
             return None
         if count < self.local_batch_size and not self.pad_final:
@@ -90,12 +93,7 @@ class ShardedFeed(object):
                 col = np.pad(col, pad)
             return col
 
-        if self.preprocess is not None:
-            local = self.preprocess(arrays)
-        elif isinstance(arrays, dict):
-            local = {name: np.asarray(col) for name, col in arrays.items()}
-        else:
-            local = np.asarray(arrays)
+        local = self.preprocess(arrays) if self.preprocess is not None else arrays
         local = jax.tree_util.tree_map(to_padded, local)
         mask = np.zeros((self.local_batch_size,), dtype=np.float32)
         mask[:count] = 1.0
@@ -153,22 +151,33 @@ class ShardedFeed(object):
         producer when the consumer exits early (max_steps / consensus)."""
         buf = _queue.Queue(maxsize=self._prefetch_depth)
 
+        def _put(item):
+            while not stop.is_set():
+                try:
+                    buf.put(item, timeout=0.2)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
         def _producer():
-            for local in self._local_iter():
-                while not stop.is_set():
-                    try:
-                        buf.put(local, timeout=0.2)
-                        break
-                    except _queue.Full:
-                        continue
-                if stop.is_set():
-                    return
+            # An exception in the feed (e.g. a dead manager) travels through
+            # the buffer so the consumer re-raises instead of blocking forever
+            # on a producer that died without its None sentinel.
+            try:
+                for local in self._local_iter():
+                    if not _put(local):
+                        return
+            except BaseException as exc:  # noqa: B036 — relayed, not handled
+                _put(exc)
 
         t = threading.Thread(target=_producer, name="infeed-prefetch",
                              daemon=True)
         t.start()
         while True:
             item = buf.get()
+            if isinstance(item, BaseException):
+                raise item
             yield item
             if item is None:
                 return

@@ -122,4 +122,8 @@ def local_batch_size(mesh, global_batch_size):
         "global batch {} not divisible by data-parallel degree {}".format(
             global_batch_size, total))
     # Every process hosts an equal slice of the mesh devices.
-    return global_batch_size // jax.process_count()
+    procs = jax.process_count()
+    assert global_batch_size % procs == 0, (
+        "global batch {} not divisible by process count {}; each host "
+        "contributes an equal local shard".format(global_batch_size, procs))
+    return global_batch_size // procs

@@ -139,10 +139,15 @@ class Trainer(object):
         """Train from a :class:`~tensorflowonspark_tpu.parallel.infeed.ShardedFeed`
         until end-of-data consensus (or ``max_steps``); returns final stats."""
         last_loss = None
+        # Host-side step counter: reading state.step would sync on the
+        # just-dispatched device step and defeat the infeed's double
+        # buffering (steps dispatch asynchronously).
+        steps_done = int(self.state.step)
         for batch, mask in sharded_feed.batches():
             loss, _ = self.step(batch, mask)
             last_loss = loss
-            if max_steps and int(self.state.step) >= max_steps:
+            steps_done += 1
+            if max_steps and steps_done >= max_steps:
                 break
         if self.history:
             self.history.on_train_end()

@@ -1,0 +1,39 @@
+"""Linear regression model — the pipeline test workload.
+
+The reference validates its Estimator/Model pipeline end-to-end on a
+synthetic linear regression with known weights (reference
+``test/test_pipeline.py:17-25,88-171``); this zoo entry plays the same role
+for the framework-native pipeline, and doubles as the smallest possible
+registry example.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import register_model
+
+
+class Linear(nn.Module):
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.features, name="dense")(x)
+
+
+@register_model("linear")
+def build_linear(features=1, in_features=None):
+    del in_features  # shape comes from the data; kept for descriptor clarity
+    return Linear(features=features)
+
+
+def loss_fn(model):
+    """Masked mean-squared-error for the Trainer contract."""
+
+    def loss(params, batch, mask):
+        preds = model.apply({"params": params}, batch["x"])[:, 0]
+        err = (preds - batch["y"]) ** 2
+        mse = (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return mse, {}
+
+    return loss

@@ -1,0 +1,148 @@
+"""Pipeline Estimator/Model tests (reference ``test/test_pipeline.py``):
+param plumbing units plus the end-to-end fit -> export -> transform loop on a
+synthetic known-weights linear regression."""
+
+import argparse
+import os
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import backend, pipeline
+
+WEIGHTS = [3.14, 1.618]  # reference test_pipeline.py:20
+
+
+# ---------------------------------------------------------------------------
+# units: Namespace / params merging (reference test_pipeline.py:47-86)
+# ---------------------------------------------------------------------------
+
+class TestNamespace:
+    def test_from_dict_and_kwargs(self):
+        ns = pipeline.Namespace({"a": 1}, b=2)
+        assert ns.a == 1 and ns.b == 2
+        assert "a" in ns and "c" not in ns
+
+    def test_from_argparse(self):
+        args = argparse.Namespace(x=10)
+        ns = pipeline.Namespace(args)
+        assert ns.x == 10
+        assert ns == args
+
+    def test_copy_semantics(self):
+        src = pipeline.Namespace({"a": 1})
+        dup = pipeline.Namespace(src)
+        dup.a = 2
+        assert src.a == 1
+
+
+class TestParams:
+    def test_defaults_and_set_get(self):
+        p = pipeline.TFParams()
+        assert p.get("batch_size") == 128
+        p.set("batch_size", 64)
+        assert p.get("batch_size") == 64
+
+    def test_camel_accessors(self):
+        p = pipeline.TFParams()
+        p.setBatchSize(32).setClusterSize(4)
+        assert p.getBatchSize() == 32 and p.getClusterSize() == 4
+
+    def test_unknown_param_rejected(self):
+        with pytest.raises(KeyError):
+            pipeline.TFParams().set("nope", 1)
+
+    def test_merge_args_params(self):
+        p = pipeline.TFParams(batch_size=17)
+        merged = p.merge_args_params(argparse.Namespace(lr=0.5, batch_size=1))
+        assert merged.batch_size == 17  # params win
+        assert merged.lr == 0.5         # args fill the rest
+
+
+class TestDatasetRows:
+    def test_dict_rows_sorted_columns(self):
+        rows, cols = pipeline._dataset_rows(
+            [{"b": 2, "a": 1}, {"b": 4, "a": 3}])
+        assert cols == ["a", "b"]
+        assert rows == [(1, 2), (3, 4)]
+
+    def test_tuple_rows_passthrough(self):
+        rows, cols = pipeline._dataset_rows([(1, 2), (3, 4)])
+        assert rows == [(1, 2), (3, 4)] and cols is None
+
+
+# ---------------------------------------------------------------------------
+# integration: fit -> export -> transform (reference test_pipeline.py:88-171)
+# ---------------------------------------------------------------------------
+
+def _make_dataset(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.random((n, 2), np.float32)
+    y = x @ np.asarray(WEIGHTS, np.float32)
+    return [{"features": x[i].tolist(), "label": float(y[i])}
+            for i in range(n)]
+
+
+def _train_fn(args, ctx):
+    """Per-node training fn: linear regression via plain jax + DataFeed,
+    chief exports the framework model artifact."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.models import get_model, linear as linear_mod
+
+    model = get_model("linear")
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 2)))["params"]
+    opt = optax.sgd(0.5, momentum=0.9)
+    opt_state = opt.init(params)
+    loss = linear_mod.loss_fn(model)
+
+    @jax.jit
+    def step(params, opt_state, batch, mask):
+        (l, _), g = jax.value_and_grad(loss, has_aux=True)(params, batch, mask)
+        updates, opt_state = opt.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, l
+
+    feed = ctx.get_data_feed(
+        input_mapping={"features": "x", "label": "y"})
+    while not feed.should_stop():
+        arrays, count = feed.next_batch_arrays(args.batch_size)
+        if count == 0:
+            continue
+        batch = {"x": np.asarray(arrays["x"], np.float32),
+                 "y": np.asarray(arrays["y"], np.float32)}
+        mask = np.ones((count,), np.float32)
+        params, opt_state, l = step(params, opt_state, batch, mask)
+
+    if ctx.job_name in ("chief", "master"):
+        checkpoint.export_model(
+            args.export_dir, jax.device_get(params), "linear",
+            model_config={"features": 1},
+            input_signature={"x": [None, 2]})
+
+
+@pytest.mark.parametrize("np_", [np])  # keep fixture-free structure flat
+def test_fit_transform_end_to_end(tmp_path, np_):
+    b = backend.LocalBackend(2)
+    try:
+        export_dir = str(tmp_path / "export")
+        est = pipeline.TFEstimator(
+            _train_fn, {"lr": 0.5}, b,
+            cluster_size=2, batch_size=64, epochs=16,
+            export_dir=export_dir, grace_secs=5,
+            input_mapping={"features": "x", "label": "y"})
+        model = est.fit(_make_dataset())
+        assert os.path.exists(os.path.join(export_dir, "export.json"))
+
+        model.set("input_mapping", {"features": "x"})
+        test_rows = [[1.0, 1.0], [2.0, 0.0], [0.0, 2.0]]
+        preds = model.transform(test_rows)
+        assert len(preds) == 3
+        expect = [sum(WEIGHTS), 2 * WEIGHTS[0], 2 * WEIGHTS[1]]
+        for pred, want in zip(preds, expect):
+            # reference asserts ~2 decimals on the learned weights
+            assert abs(pred[0] - want) < 0.1, (pred, want)
+    finally:
+        b.stop()

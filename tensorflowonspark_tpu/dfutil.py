@@ -1,0 +1,181 @@
+"""TFRecord <-> row-table converters with schema inference (reference ``dfutil.py``).
+
+The reference converts Spark DataFrames to/from TFRecord files through the
+Hadoop input/output formats plus TF's Example classes (reference
+``dfutil.py:29-81``), inferring a schema by probing the first record
+(``dfutil.py:68-71``) with a ``binary_features`` hint disambiguating
+bytes-vs-string (``dfutil.py:84-131``).  This module provides the same
+surface over the first-party codec stack — C++ TFRecord framing
+(:mod:`~tensorflowonspark_tpu.tfrecord`) and the no-TF Example proto codec
+(:mod:`~tensorflowonspark_tpu.example_proto`) — with "DataFrame" generalized
+to a list of row dicts (Spark DataFrames convert via ``.collect()``/rdds at
+the call site; no JVM in the loop).
+
+Schema types: ``int64 | float32 | string | binary`` and their
+``array<...>`` forms.  Like the reference's inference, scalars vs arrays are
+guessed from the value count (len 1 = scalar) — documented lossy
+(reference ``DFUtilTest.scala:95-132``).
+"""
+
+import glob
+import logging
+import os
+
+from tensorflowonspark_tpu import example_proto, tfrecord
+
+logger = logging.getLogger(__name__)
+
+def isLoadedDF(rows):
+    """True if ``rows`` came from :func:`load_tfrecords` (reference
+    ``dfutil.py:18-26``, which tracked provenance in a ``loadedDF`` dict;
+    here provenance rides on the :class:`Rows` object itself — a global
+    id-keyed table would leak and give false positives on recycled ids)."""
+    return getattr(rows, "source_dir", None) is not None
+
+
+class Rows(list):
+    """A list of row dicts with an attached ``schema`` ({col: type}) and,
+    when loaded from TFRecords, the ``source_dir`` provenance."""
+
+    def __init__(self, rows=(), schema=None, source_dir=None):
+        super(Rows, self).__init__(rows)
+        self.schema = schema or {}
+        self.source_dir = source_dir
+
+
+# ---------------------------------------------------------------------------
+# row <-> Example
+# ---------------------------------------------------------------------------
+
+_SCALAR_KINDS = {"int64": "int64", "float32": "float",
+                 "string": "bytes", "binary": "bytes"}
+
+
+def _base_type(coltype):
+    return coltype[len("array<"):-1] if coltype.startswith("array<") else coltype
+
+
+def to_example(row, schema):
+    """Encode one row dict as serialized Example bytes (reference
+    ``toTFExample``, ``dfutil.py:84-131``)."""
+    features = {}
+    for name, coltype in schema.items():
+        value = row[name]
+        base = _base_type(coltype)
+        kind = _SCALAR_KINDS[base]
+        values = value if coltype.startswith("array<") else [value]
+        features[name] = (kind, list(values))
+    return example_proto.encode_example(features)
+
+
+def from_example(serialized, schema, binary_features=()):
+    """Decode serialized Example bytes into a row dict (reference
+    ``fromTFExample``, ``dfutil.py:171-212``)."""
+    feats = example_proto.decode_example(serialized)
+    row = {}
+    for name, coltype in schema.items():
+        kind, values = feats.get(name, ("bytes", []))
+        base = _base_type(coltype)
+        if base == "string":
+            values = [v.decode("utf-8") if isinstance(v, bytes) else v
+                      for v in values]
+        elif base == "float32":
+            values = [float(v) for v in values]
+        elif base == "int64":
+            values = [int(v) for v in values]
+        if coltype.startswith("array<"):
+            row[name] = values
+        else:
+            row[name] = values[0] if values else None
+    return row
+
+
+def infer_schema(serialized, binary_features=()):
+    """Infer {col: type} from one serialized Example (reference
+    ``infer_schema``, ``dfutil.py:134-168``): int64/float kinds map
+    directly; bytes is ``string`` unless hinted ``binary``; count 1 means
+    scalar, else array (documented lossy)."""
+    feats = example_proto.decode_example(serialized)
+    schema = {}
+    for name, (kind, values) in feats.items():
+        if kind == "int64":
+            base = "int64"
+        elif kind == "float":
+            base = "float32"
+        else:
+            base = "binary" if name in binary_features else "string"
+        schema[name] = base if len(values) <= 1 else "array<{}>".format(base)
+    return schema
+
+
+# ---------------------------------------------------------------------------
+# file-level save/load
+# ---------------------------------------------------------------------------
+
+def save_as_tfrecords(rows, output_dir, schema=None, num_shards=1):
+    """Write rows as sharded TFRecord part files (reference
+    ``saveAsTFRecords``, ``dfutil.py:29-41``; part-file naming matches the
+    Hadoop output format's convention).  Returns the shard paths."""
+    if schema is None and isinstance(rows, Rows) and rows.schema:
+        schema = rows.schema
+    rows = list(rows)
+    if schema is None:
+        schema = infer_row_schema(rows[0]) if rows else {}
+    os.makedirs(output_dir, exist_ok=True)
+    paths = []
+    num_shards = max(num_shards, 1)
+    per_shard = (len(rows) + num_shards - 1) // num_shards
+    for shard in range(num_shards):
+        path = os.path.join(output_dir, "part-r-{:05d}".format(shard))
+        with tfrecord.TFRecordWriter(path) as w:
+            for row in rows[shard * per_shard:(shard + 1) * per_shard]:
+                w.write(to_example(row, schema))
+        paths.append(path)
+    logger.info("saved %d rows to %d shards in %s", len(rows),
+                len(paths), output_dir)
+    return paths
+
+
+def load_tfrecords(input_dir, binary_features=(), schema=None):
+    """Load a TFRecord dir into :class:`Rows`, inferring the schema from the
+    first record unless given (reference ``loadTFRecords``,
+    ``dfutil.py:44-81``; schema probe 68-71)."""
+    paths = sorted(glob.glob(os.path.join(input_dir, "part-*")))
+    if not paths:
+        paths = sorted(glob.glob(os.path.join(input_dir, "*.tfrecord*")))
+    assert paths, "no TFRecord part files under {}".format(input_dir)
+    out = Rows()
+    for path in paths:
+        for record in tfrecord.tfrecord_iterator(path):
+            if schema is None:
+                schema = infer_schema(record, binary_features)
+                logger.info("inferred schema: %s", schema)
+            out.append(from_example(record, schema, binary_features))
+    out.schema = schema or {}
+    out.source_dir = input_dir
+    return out
+
+
+def infer_row_schema(row):
+    """Infer {col: type} from a Python row dict (save-side inference; the
+    reference derived this from the DataFrame's SQL schema,
+    ``dfutil.py:99-103``)."""
+    schema = {}
+    for name, value in row.items():
+        is_array = isinstance(value, (list, tuple))
+        probe = value[0] if is_array and value else value
+        if isinstance(probe, bool):
+            raise ValueError("bool column {!r} unsupported (use int64)".format(name))
+        if isinstance(probe, int):
+            base = "int64"
+        elif isinstance(probe, float):
+            base = "float32"
+        elif isinstance(probe, (bytes, bytearray)):
+            base = "binary"
+        elif isinstance(probe, str):
+            base = "string"
+        else:
+            raise ValueError("unsupported type {!r} for column {!r}".format(
+                type(probe), name))
+        schema[name] = "array<{}>".format(base) if is_array else base
+    return schema

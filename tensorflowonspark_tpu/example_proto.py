@@ -1,0 +1,232 @@
+"""Minimal ``tf.train.Example`` protobuf codec — no TensorFlow dependency.
+
+The reference builds/parses ``tf.train.Example`` via the TF runtime
+(reference ``dfutil.py:84-131,171-212``; Scala twin ``DFUtil.scala:119-184``
+uses the protobuf classes from the tensorflow-hadoop jar).  This module
+implements just the wire format those messages use, so the framework can
+exchange TFRecord+Example data with any TF/JAX/beam pipeline without
+importing TF:
+
+    Example      { Features features = 1; }
+    Features     { map<string, Feature> feature = 1; }
+    Feature      { oneof kind { BytesList bytes_list = 1;
+                                FloatList float_list = 2;
+                                Int64List int64_list = 3; } }
+    BytesList    { repeated bytes value = 1; }
+    FloatList    { repeated float value = 1 [packed]; }
+    Int64List    { repeated int64 value = 1 [packed]; }
+
+The Python surface is plain dicts: ``{name: (kind, [values])}`` with kind in
+``'bytes' | 'float' | 'int64'``.
+"""
+
+import struct
+
+_WIRE_VARINT = 0
+_WIRE_I64 = 1
+_WIRE_LEN = 2
+_WIRE_I32 = 5
+
+
+# ---------------------------------------------------------------------------
+# primitive wire helpers
+# ---------------------------------------------------------------------------
+
+def _write_varint(out, value):
+    if value < 0:
+        value += 1 << 64  # two's-complement int64 varint
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return
+
+
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+    if result >= 1 << 63:
+        result -= 1 << 64  # negative int64
+    return result, pos
+
+
+def _write_tag(out, field, wire):
+    _write_varint(out, (field << 3) | wire)
+
+
+def _write_len_delimited(out, field, payload):
+    _write_tag(out, field, _WIRE_LEN)
+    _write_varint(out, len(payload))
+    out.extend(payload)
+
+
+def _skip(buf, pos, wire):
+    if wire == _WIRE_VARINT:
+        _, pos = _read_varint(buf, pos)
+    elif wire == _WIRE_I64:
+        pos += 8
+    elif wire == _WIRE_LEN:
+        n, pos = _read_varint(buf, pos)
+        pos += n
+    elif wire == _WIRE_I32:
+        pos += 4
+    else:
+        raise ValueError("unsupported wire type {}".format(wire))
+    return pos
+
+
+# ---------------------------------------------------------------------------
+# encode
+# ---------------------------------------------------------------------------
+
+def _encode_feature(kind, values):
+    inner = bytearray()
+    if kind == "bytes":
+        for v in values:
+            if isinstance(v, str):
+                v = v.encode("utf-8")
+            _write_len_delimited(inner, 1, bytes(v))
+        field = 1
+    elif kind == "float":
+        packed = struct.pack("<{}f".format(len(values)), *values)
+        _write_len_delimited(inner, 1, packed)
+        field = 2
+    elif kind == "int64":
+        packed = bytearray()
+        for v in values:
+            _write_varint(packed, int(v))
+        _write_len_delimited(inner, 1, bytes(packed))
+        field = 3
+    else:
+        raise ValueError("unknown feature kind {!r}".format(kind))
+    out = bytearray()
+    _write_len_delimited(out, field, bytes(inner))
+    return bytes(out)
+
+
+def encode_example(features):
+    """Serialize ``{name: (kind, [values])}`` to ``tf.train.Example`` bytes."""
+    feats = bytearray()
+    for name in sorted(features):
+        kind, values = features[name]
+        entry = bytearray()
+        _write_len_delimited(entry, 1, name.encode("utf-8"))   # map key
+        _write_len_delimited(entry, 2, _encode_feature(kind, values))
+        _write_len_delimited(feats, 1, bytes(entry))           # map entry
+    out = bytearray()
+    _write_len_delimited(out, 1, bytes(feats))                 # features = 1
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+
+def _decode_list(buf, field):
+    """Decode BytesList/FloatList/Int64List payload by enclosing field no."""
+    values = []
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        fno, wire = tag >> 3, tag & 7
+        if fno != 1:
+            pos = _skip(buf, pos, wire)
+            continue
+        if field == 1:  # bytes
+            n, pos = _read_varint(buf, pos)
+            values.append(bytes(buf[pos:pos + n]))
+            pos += n
+        elif field == 2:  # float: packed or unpacked fixed32
+            if wire == _WIRE_LEN:
+                n, pos = _read_varint(buf, pos)
+                values.extend(struct.unpack("<{}f".format(n // 4),
+                                            buf[pos:pos + n]))
+                pos += n
+            else:
+                values.append(struct.unpack("<f", buf[pos:pos + 4])[0])
+                pos += 4
+        else:  # int64: packed or unpacked varints
+            if wire == _WIRE_LEN:
+                n, pos = _read_varint(buf, pos)
+                end = pos + n
+                while pos < end:
+                    v, pos = _read_varint(buf, pos)
+                    values.append(v)
+            else:
+                v, pos = _read_varint(buf, pos)
+                values.append(v)
+    return values
+
+
+_KIND_BY_FIELD = {1: "bytes", 2: "float", 3: "int64"}
+
+
+def _decode_feature(buf):
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        fno, wire = tag >> 3, tag & 7
+        if fno in _KIND_BY_FIELD and wire == _WIRE_LEN:
+            n, pos = _read_varint(buf, pos)
+            return _KIND_BY_FIELD[fno], _decode_list(buf[pos:pos + n], fno)
+        pos = _skip(buf, pos, wire)
+    return "bytes", []  # empty Feature
+
+
+def decode_example(data):
+    """Parse ``tf.train.Example`` bytes to ``{name: (kind, [values])}``."""
+    data = memoryview(bytes(data))
+    features = {}
+    pos = 0
+    # Example level: find features (field 1)
+    while pos < len(data):
+        tag, pos = _read_varint(data, pos)
+        fno, wire = tag >> 3, tag & 7
+        if fno == 1 and wire == _WIRE_LEN:
+            n, pos = _read_varint(data, pos)
+            fbuf = data[pos:pos + n]
+            pos += n
+            # Features level: repeated map entries (field 1)
+            fpos = 0
+            while fpos < len(fbuf):
+                ftag, fpos = _read_varint(fbuf, fpos)
+                ffno, fwire = ftag >> 3, ftag & 7
+                if ffno != 1 or fwire != _WIRE_LEN:
+                    fpos = _skip(fbuf, fpos, fwire)
+                    continue
+                en, fpos = _read_varint(fbuf, fpos)
+                entry = fbuf[fpos:fpos + en]
+                fpos += en
+                # map entry: key = 1, value = 2
+                key, feature = None, ("bytes", [])
+                epos = 0
+                while epos < len(entry):
+                    etag, epos = _read_varint(entry, epos)
+                    efno, ewire = etag >> 3, etag & 7
+                    if efno == 1 and ewire == _WIRE_LEN:
+                        kn, epos = _read_varint(entry, epos)
+                        key = bytes(entry[epos:epos + kn]).decode("utf-8")
+                        epos += kn
+                    elif efno == 2 and ewire == _WIRE_LEN:
+                        vn, epos = _read_varint(entry, epos)
+                        feature = _decode_feature(entry[epos:epos + vn])
+                        epos += vn
+                    else:
+                        epos = _skip(entry, epos, ewire)
+                if key is not None:
+                    features[key] = feature
+        else:
+            pos = _skip(data, pos, wire)
+    return features

@@ -1,0 +1,56 @@
+"""Build/load shim for the first-party native library.
+
+The reference shipped native capability as pre-built binaries (the
+tensorflow-hadoop jar, libtensorflow JNI — SURVEY §2.3); here the C++
+source lives in ``native/`` and is compiled on first use with the host
+toolchain, cached next to the source, and loaded via ctypes.  Consumers
+must tolerate a missing toolchain: every native-backed module has a pure
+Python fallback (e.g. :mod:`~tensorflowonspark_tpu.tfrecord`).
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_lock = threading.Lock()
+_cache = {}
+
+
+def load(name, sources=None):
+    """Load ``lib<name>.so``, building it from ``native/<name>.cc`` first if
+    missing or stale; returns a ``ctypes.CDLL`` or None on any failure."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        lib = None
+        try:
+            src = os.path.join(_NATIVE_DIR, (sources or name + ".cc"))
+            so = os.path.join(_NATIVE_DIR, "lib{}.so".format(name))
+            if os.path.exists(src):
+                stale = (not os.path.exists(so)
+                         or os.path.getmtime(so) < os.path.getmtime(src))
+                if stale:
+                    # Compile to a private temp file, then atomically rename:
+                    # many executor processes race this build on one host, and
+                    # dlopen of a half-written .so would permanently demote
+                    # that process to the pure-python fallback.
+                    tmp = "{}.tmp.{}".format(so, os.getpid())
+                    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+                           "-o", tmp, src]
+                    logger.info("building native lib: %s", " ".join(cmd))
+                    subprocess.run(cmd, check=True, capture_output=True,
+                                   timeout=120)
+                    os.replace(tmp, so)
+                lib = ctypes.CDLL(so)
+        except Exception:
+            logger.warning("native %s unavailable; using pure-python fallback",
+                           name, exc_info=True)
+            lib = None
+        _cache[name] = lib
+        return lib

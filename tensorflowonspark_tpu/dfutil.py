@@ -68,9 +68,11 @@ def to_example(row, schema):
     return example_proto.encode_example(features)
 
 
-def from_example(serialized, schema, binary_features=()):
+def from_example(serialized, schema):
     """Decode serialized Example bytes into a row dict (reference
-    ``fromTFExample``, ``dfutil.py:171-212``)."""
+    ``fromTFExample``, ``dfutil.py:171-212``).  Bytes-vs-string handling is
+    driven entirely by the schema's column types (a ``binary_features`` hint
+    only matters at schema-inference time, see :func:`infer_schema`)."""
     feats = example_proto.decode_example(serialized)
     row = {}
     for name, coltype in schema.items():
@@ -143,14 +145,15 @@ def load_tfrecords(input_dir, binary_features=(), schema=None):
     paths = sorted(glob.glob(os.path.join(input_dir, "part-*")))
     if not paths:
         paths = sorted(glob.glob(os.path.join(input_dir, "*.tfrecord*")))
-    assert paths, "no TFRecord part files under {}".format(input_dir)
+    if not paths:
+        raise IOError("no TFRecord part files under {}".format(input_dir))
     out = Rows()
     for path in paths:
         for record in tfrecord.tfrecord_iterator(path):
             if schema is None:
                 schema = infer_schema(record, binary_features)
                 logger.info("inferred schema: %s", schema)
-            out.append(from_example(record, schema, binary_features))
+            out.append(from_example(record, schema))
     out.schema = schema or {}
     out.source_dir = input_dir
     return out

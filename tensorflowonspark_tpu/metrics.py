@@ -15,7 +15,7 @@ logger = logging.getLogger(__name__)
 
 # Peak dense (bf16) FLOPs per chip for MFU accounting.
 PEAK_FLOPS = {
-    "tpu v5 lite": 394e12,   # v5e: 394 TFLOP/s bf16
+    "tpu v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16 (394 is the int8 figure)
     "tpu v5": 459e12,        # v5p
     "tpu v4": 275e12,
     "tpu v6 lite": 918e12,   # v6e / trillium

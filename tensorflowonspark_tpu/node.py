@@ -443,7 +443,13 @@ def _join_with_error_check(mgr, queue, timeout, phase):
     joined = threading.Event()
 
     def _join():
-        queue.join()
+        try:
+            queue.join()
+        except (EOFError, ConnectionError, BrokenPipeError):
+            # Manager went away (executor died mid-feed); the error-queue
+            # poll below surfaces the real failure — don't dump this
+            # daemon thread's traceback on top of it.
+            return
         joined.set()
 
     t = threading.Thread(target=_join, daemon=True)

@@ -95,7 +95,9 @@ def _train_fn(args, ctx):
 
     model = get_model("linear")
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 2)))["params"]
-    opt = optax.sgd(0.5, momentum=0.9)
+    # adam converges monotonically here regardless of queue-arrival order;
+    # momentum-SGD oscillates and can land just outside tolerance.
+    opt = optax.adam(0.25)
     opt_state = opt.init(params)
     loss = linear_mod.loss_fn(model)
 
@@ -130,7 +132,7 @@ def test_fit_transform_end_to_end(tmp_path, np_):
         export_dir = str(tmp_path / "export")
         est = pipeline.TFEstimator(
             _train_fn, {"lr": 0.5}, b,
-            cluster_size=2, batch_size=64, epochs=16,
+            cluster_size=2, batch_size=64, epochs=32,
             export_dir=export_dir, grace_secs=5,
             input_mapping={"features": "x", "label": "y"})
         model = est.fit(_make_dataset())

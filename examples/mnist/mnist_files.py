@@ -1,0 +1,143 @@
+"""MNIST training, InputMode.FILES (reference ``examples/mnist/keras/mnist_tf.py``).
+
+The reference's TENSORFLOW mode: no Spark feeding — every worker reads its
+shard of the dataset itself (reference ``mnist_tf.py:23-27`` uses tfds with
+``ds.shard``) while the cluster machinery provides rendezvous, lifecycle and
+failure propagation.  Here each worker reads the TFRecords staged by
+``mnist_data_setup.py`` (or generates synthetic data), shards them by
+process, and drives the same Trainer step; checkpointing is periodic via
+CheckpointManager with restore-on-restart (reference ``mnist_tf.py``
+checkpoints through Keras callbacks).
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/mnist/mnist_files.py --cluster_size 2 --epochs 1
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main_fun(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint, dfutil
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import mnist as mnist_mod
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    ctx.initialize_distributed()
+    mesh = mesh_mod.build_mesh()
+
+    # Each process reads + shards the dataset itself (FILES mode contract).
+    if args.data_dir:
+        rows = dfutil.load_tfrecords(os.path.join(args.data_dir, "train"))
+        images = np.asarray([r["image"] for r in rows], np.float32)
+        labels = np.asarray([r["label"] for r in rows], np.int32)
+    else:
+        from mnist_data_setup import synthetic_mnist
+
+        raw, labels = synthetic_mnist("train")
+        images = (raw / 255.0).astype(np.float32)
+        labels = labels.astype(np.int32)
+    images = images.reshape(-1, 28, 28, 1)
+    shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
+    images, labels = images[shard], labels[shard]
+
+    model = mnist_mod.build_mnist(dtype="bfloat16")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    trainer = train_mod.Trainer(
+        mnist_mod.loss_fn(model), params,
+        optax.sgd(args.lr, momentum=0.9), mesh=mesh,
+        compute_dtype=jnp.bfloat16, batch_size=args.batch_size)
+
+    ckpt = None
+    if args.model_dir:
+        ckpt = checkpoint.CheckpointManager(
+            ctx.absolute_path(args.model_dir),
+            save_interval_steps=args.save_interval)
+        state, step = ckpt.restore_latest(
+            jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                jax.device_get(trainer.state)))
+        if state is not None:
+            trainer.state = jax.device_put(state,
+                                           mesh_mod.replicated(mesh))
+
+    local_bs = mesh_mod.local_batch_size(mesh, args.batch_size)
+    sharding = mesh_mod.batch_sharding(mesh)
+    steps_per_epoch = len(labels) // local_bs
+    step_count = int(trainer.state.step)
+    rng = np.random.default_rng(jax.process_index())
+    for _ in range(args.epochs):
+        order = rng.permutation(len(labels))
+        for s in range(steps_per_epoch):
+            idx = order[s * local_bs:(s + 1) * local_bs]
+            batch = {
+                "image": jax.make_array_from_process_local_data(
+                    sharding, images[idx]),
+                "label": jax.make_array_from_process_local_data(
+                    sharding, labels[idx]),
+            }
+            mask = jax.make_array_from_process_local_data(
+                sharding, np.ones((local_bs,), np.float32))
+            loss, aux = trainer.step(batch, mask)
+            step_count += 1
+            if ckpt:
+                ckpt.maybe_save(step_count, jax.device_get(trainer.state))
+            if args.max_steps and step_count >= args.max_steps:
+                break
+        if args.max_steps and step_count >= args.max_steps:
+            break
+
+    trainer.history.on_train_end()
+    stats = trainer.history.log_stats(loss=float(loss))
+    if ckpt:
+        ckpt.maybe_save(step_count, jax.device_get(trainer.state), force=True)
+        ckpt.wait_until_finished()
+        ckpt.close()
+    if args.export_dir and checkpoint.should_export(ctx):
+        checkpoint.export_model(
+            ctx.absolute_path(args.export_dir),
+            jax.device_get(trainer.state.params), "mnist_cnn",
+            model_config={"dtype": "bfloat16"},
+            input_signature={"image": [None, 28, 28, 1]})
+    return stats
+
+
+def main(argv=None):
+    from tensorflowonspark_tpu import backend, cluster
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=256)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--max_steps", type=int, default=None)
+    parser.add_argument("--save_interval", type=int, default=100)
+    parser.add_argument("--data_dir", default=None,
+                        help="TFRecord root from mnist_data_setup.py "
+                             "(expects <data_dir>/train); synthetic if omitted")
+    parser.add_argument("--model_dir", default=None,
+                        help="checkpoint dir (shared storage on multi-host)")
+    parser.add_argument("--export_dir", default=None)
+    args, _ = parser.parse_known_args(argv)
+
+    b = backend.LocalBackend(args.cluster_size)
+    try:
+        c = cluster.run(b, main_fun, args, num_executors=args.cluster_size,
+                        input_mode=cluster.InputMode.FILES)
+        c.shutdown(grace_secs=2)
+    finally:
+        b.stop()
+
+
+if __name__ == "__main__":
+    main()

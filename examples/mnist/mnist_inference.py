@@ -1,0 +1,105 @@
+"""Clusterless parallel MNIST inference (reference ``examples/mnist/keras/mnist_inference.py``).
+
+The reference shows that batch inference needs no TFCluster at all: a plain
+``mapPartitions`` where each executor lazily loads the SavedModel once and
+streams its partition through it (reference ``mnist_inference.py:24-89``,
+``ds.shard(num_workers, worker_num)`` 51).  Here the same embarrassingly-
+parallel pattern uses the framework export (orbax params + descriptor):
+each executor caches the rebuilt model + jitted apply in process-global
+state and maps its partitions to (prediction, label) lines.
+
+Run (after mnist_spark.py or mnist_files.py exported a model):
+    JAX_PLATFORMS=cpu python examples/mnist/mnist_inference.py \
+        --export_dir /tmp/mnist_export --output /tmp/mnist_preds
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+_CACHE = {}  # process-global model cache (reference pred_fn/pred_args globals)
+
+
+def infer_partition(export_dir, batch_size):
+    """Build the per-partition inference closure; the model loads once per
+    executor process and is reused across partitions (reference
+    ``mnist_inference.py`` / ``pipeline.py:474-481`` cache pattern)."""
+
+    def _infer(iterator):
+        import jax
+        import numpy as np
+
+        from tensorflowonspark_tpu import checkpoint
+        from tensorflowonspark_tpu.models import get_model
+
+        if "apply" not in _CACHE:
+            params, desc = checkpoint.load_model(export_dir)
+            model = get_model(desc["model_name"], **desc.get("model_config", {}))
+            _CACHE["apply"] = jax.jit(
+                lambda p, x: model.apply({"params": p}, x))
+            _CACHE["params"] = params
+        apply_fn, params = _CACHE["apply"], _CACHE["params"]
+
+        rows = list(iterator)
+        out = []
+        for i in range(0, len(rows), batch_size):
+            chunk = np.asarray(rows[i:i + batch_size], np.float32)
+            labels = chunk[:, 0].astype(np.int32)
+            images = (chunk[:, 1:] / 255.0).reshape(-1, 28, 28, 1)
+            logits = np.asarray(apply_fn(params, images))
+            preds = logits.argmax(-1)
+            out.extend("{} {}".format(int(p), int(l))
+                       for p, l in zip(preds, labels))
+        return out
+
+    return _infer
+
+
+def main(argv=None):
+    from tensorflowonspark_tpu import backend
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=256)
+    parser.add_argument("--export_dir", required=True)
+    parser.add_argument("--data_dir", default=None,
+                        help="CSV dir from mnist_data_setup.py; synthetic "
+                             "test split when omitted")
+    parser.add_argument("--output", default=None,
+                        help="write 'pred label' lines here (stdout summary "
+                             "otherwise)")
+    args, _ = parser.parse_known_args(argv)
+
+    if args.data_dir:
+        from mnist_spark import csv_partitions
+
+        parts = list(csv_partitions(args.data_dir))
+    else:
+        from mnist_data_setup import synthetic_mnist
+
+        images, labels = synthetic_mnist("test")
+        rows = [[float(labels[i])] + images[i].astype(float).tolist()
+                for i in range(2048)]
+        parts = backend.partition(rows, args.cluster_size * 2)
+
+    b = backend.LocalBackend(args.cluster_size)
+    try:
+        results = b.map_partitions(
+            parts, infer_partition(args.export_dir, args.batch_size))
+    finally:
+        b.stop()
+    lines = [line for part in results for line in part]
+    correct = sum(1 for line in lines
+                  if line.split()[0] == line.split()[1])
+    print("accuracy: {:.4f} ({}/{})".format(
+        correct / len(lines), correct, len(lines)))
+    if args.output:
+        os.makedirs(os.path.dirname(args.output) or ".", exist_ok=True)
+        with open(args.output, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

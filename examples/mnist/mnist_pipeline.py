@@ -1,0 +1,102 @@
+"""MNIST via the ML pipeline API (reference ``examples/mnist/keras/mnist_pipeline.py``).
+
+``TFEstimator.fit`` spins up the cluster, feeds the train rows, exports on
+the chief, and returns a ``TFModel`` whose ``transform`` runs cached
+per-executor batch inference (reference ``mnist_pipeline.py:124-149``).
+
+Run:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/mnist/mnist_pipeline.py --cluster_size 2 --epochs 1
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def train_fn(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import mnist as mnist_mod
+    from tensorflowonspark_tpu.parallel import infeed, mesh as mesh_mod
+
+    ctx.initialize_distributed()
+    mesh = mesh_mod.build_mesh()
+    model = mnist_mod.build_mnist(dtype="bfloat16")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    trainer = train_mod.Trainer(
+        mnist_mod.loss_fn(model), params,
+        optax.sgd(args.lr, momentum=0.9), mesh=mesh,
+        compute_dtype=jnp.bfloat16, batch_size=args.batch_size)
+
+    def preprocess(items):
+        cols = items  # dict of columns via input_mapping
+        images = np.asarray(cols["image"], np.float32).reshape(-1, 28, 28, 1)
+        labels = np.asarray(cols["label"], np.int32)
+        return {"image": images, "label": labels}
+
+    feed = ctx.get_data_feed(
+        input_mapping={"image": "image", "label": "label"})
+    sharded = infeed.ShardedFeed(feed, mesh, args.batch_size,
+                                 preprocess=preprocess)
+    trainer.fit_feed(sharded)
+
+    if checkpoint.should_export(ctx):
+        checkpoint.export_model(
+            args.export_dir, jax.device_get(trainer.state.params),
+            "mnist_cnn", model_config={"dtype": "bfloat16"},
+            input_signature={"image": [None, 28, 28, 1]})
+
+
+def main(argv=None):
+    import numpy as np
+
+    from tensorflowonspark_tpu import backend, pipeline
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=256)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--export_dir", default="/tmp/mnist_pipeline_export")
+    args, _ = parser.parse_known_args(argv)
+
+    from mnist_data_setup import synthetic_mnist
+
+    images, labels = synthetic_mnist("train")
+    n = 4096
+    train_rows = [{"image": (images[i] / 255.0).astype(np.float32).tolist(),
+                   "label": int(labels[i])} for i in range(n)]
+
+    b = backend.LocalBackend(args.cluster_size)
+    try:
+        est = pipeline.TFEstimator(
+            train_fn, {"lr": args.lr}, b,
+            cluster_size=args.cluster_size, batch_size=args.batch_size,
+            epochs=args.epochs, export_dir=args.export_dir, grace_secs=5,
+            input_mapping={"image": "image", "label": "label"})
+        model = est.fit(train_rows)
+
+        timages, tlabels = synthetic_mnist("test")
+        model.set("input_mapping", {"image": "image"})
+        test_rows = [{"image": (timages[i] / 255.0).astype(np.float32).tolist()}
+                     for i in range(512)]
+        preds = model.transform(test_rows)
+        correct = sum(1 for p, want in zip(preds, tlabels[:512])
+                      if int(np.argmax(p)) == int(want))
+        print("pipeline accuracy: {:.4f} ({}/{})".format(
+            correct / len(preds), correct, len(preds)))
+    finally:
+        b.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""MNIST training with Spark-pushed data (reference ``examples/mnist/keras/mnist_spark.py``).
+
+The reference feeds RDD partitions element-by-element through a generator
+into ``model.fit`` (reference ``mnist_spark.py:31-66``) and works around
+uneven partitions by stopping at 90% of the steps (``mnist_spark.py:58-66``).
+Here the same InputMode.SPARK lifecycle drives the TPU-native data path:
+DataFeed -> ShardedFeed (columnar per-host batches, device transfer,
+end-of-data consensus instead of the 90% heuristic) -> Trainer (bf16 pjit
+step), and the chief exports the model for the inference/pipeline examples.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/mnist/mnist_spark.py --cluster_size 2 --epochs 2
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main_fun(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import mnist as mnist_mod
+    from tensorflowonspark_tpu.parallel import infeed, mesh as mesh_mod
+
+    ctx.initialize_distributed()
+    mesh = mesh_mod.build_mesh()
+
+    model = mnist_mod.build_mnist(dtype="bfloat16")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    trainer = train_mod.Trainer(
+        mnist_mod.loss_fn(model), params,
+        optax.sgd(args.lr, momentum=0.9), mesh=mesh,
+        compute_dtype=jnp.bfloat16, batch_size=args.batch_size)
+
+    def preprocess(items):
+        # CSV rows arrive as (label, 784 pixels); TFRecord rows as dicts.
+        if items and isinstance(items[0], dict):
+            images = np.asarray([r["image"] for r in items], np.float32)
+            labels = np.asarray([r["label"] for r in items], np.int32)
+        else:
+            rows = np.asarray(items, np.float32)
+            labels = rows[:, 0].astype(np.int32)
+            images = rows[:, 1:] / 255.0
+        return {"image": images.reshape(-1, 28, 28, 1), "label": labels}
+
+    feed = ctx.get_data_feed(train_mode=True)
+    sharded = infeed.ShardedFeed(
+        feed, mesh, args.batch_size,
+        preprocess=lambda items: preprocess(items))
+    stats = trainer.fit_feed(sharded, max_steps=args.max_steps)
+
+    if args.export_dir and checkpoint.should_export(ctx):
+        checkpoint.export_model(
+            ctx.absolute_path(args.export_dir),
+            jax.device_get(trainer.state.params), "mnist_cnn",
+            model_config={"dtype": "bfloat16"},
+            input_signature={"image": [None, 28, 28, 1]})
+    return stats
+
+
+def csv_partitions(data_dir):
+    """Yield one list of (label, pixels...) rows per CSV part file."""
+    import glob
+
+    for path in sorted(glob.glob(os.path.join(data_dir, "part-*.csv"))):
+        rows = []
+        with open(path) as f:
+            for line in f:
+                rows.append([float(v) for v in line.strip().split(",")])
+        yield rows
+
+
+def main(argv=None):
+    from tensorflowonspark_tpu import backend, cluster
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=256,
+                        help="global batch size across all hosts")
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--max_steps", type=int, default=None)
+    parser.add_argument("--data_dir", default=None,
+                        help="CSV dir from mnist_data_setup.py; synthetic "
+                             "in-memory data when omitted")
+    parser.add_argument("--export_dir", default="mnist_export")
+    parser.add_argument("--tensorboard", action="store_true")
+    args, _ = parser.parse_known_args(argv)
+
+    b = backend.LocalBackend(args.cluster_size)
+    try:
+        c = cluster.run(b, main_fun, args, num_executors=args.cluster_size,
+                        input_mode=cluster.InputMode.SPARK,
+                        tensorboard=args.tensorboard)
+        if args.data_dir:
+            parts = list(csv_partitions(args.data_dir))
+        else:
+            from mnist_data_setup import synthetic_mnist
+
+            images, labels = synthetic_mnist("train")
+            rows = [[float(labels[i])] + images[i].astype(float).tolist()
+                    for i in range(4096)]
+            parts = backend.partition(rows, args.cluster_size * 4)
+        c.train(parts, num_epochs=args.epochs)
+        c.shutdown(grace_secs=5)
+    finally:
+        b.stop()
+
+
+if __name__ == "__main__":
+    main()

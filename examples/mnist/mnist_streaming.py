@@ -1,0 +1,77 @@
+"""Streaming MNIST training (reference ``examples/mnist/estimator/mnist_spark_streaming.py``).
+
+The reference trains from a Spark DStream — unbounded partitions arriving
+over time — and stops on an external signal (reference
+``mnist_spark_streaming.py:138-144`` + ``examples/utils/stop_streaming.py``).
+The TPU-native equivalent keeps the synchronous mesh stepping while data
+trickles in (SURVEY §7.4.4): the feed is an unbounded generator of
+partitions; training ends when a STOP reaches the reservation server —
+sent by ``examples/utils/stop_streaming.py`` or ``--max_batches``.
+
+Run (CPU mesh), then stop from another shell:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/mnist/mnist_streaming.py --cluster_size 2
+    python examples/utils/stop_streaming.py <host> <port>
+"""
+
+import argparse
+import itertools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from mnist_spark import main_fun  # same training fn; the feed differs  # noqa: E402
+
+
+def stream_partitions(batch_rows, interval_secs, max_batches):
+    """Unbounded generator of partitions: one partition per 'micro-batch'
+    (the DStream analogue), throttled like an arriving stream."""
+    from mnist_data_setup import synthetic_mnist
+
+    images, labels = synthetic_mnist("train")
+    counter = itertools.count()
+    for i in counter:
+        if max_batches and i >= max_batches:
+            return
+        lo = (i * batch_rows) % (len(labels) - batch_rows)
+        rows = [[float(labels[j])] + images[j].astype(float).tolist()
+                for j in range(lo, lo + batch_rows)]
+        yield rows
+        time.sleep(interval_secs)
+
+
+def main(argv=None):
+    from tensorflowonspark_tpu import backend, cluster
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=256)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--max_steps", type=int, default=None)
+    parser.add_argument("--export_dir", default=None)
+    parser.add_argument("--stream_rows", type=int, default=512,
+                        help="rows per arriving micro-batch")
+    parser.add_argument("--stream_interval", type=float, default=0.1)
+    parser.add_argument("--max_batches", type=int, default=None,
+                        help="end the stream after N micro-batches "
+                             "(unbounded when omitted: stop externally)")
+    args, _ = parser.parse_known_args(argv)
+
+    b = backend.LocalBackend(args.cluster_size)
+    try:
+        c = cluster.run(b, main_fun, args, num_executors=args.cluster_size,
+                        input_mode=cluster.InputMode.SPARK)
+        host, port = c.cluster_meta["server_addr"]
+        print("streaming; stop with: python examples/utils/stop_streaming.py "
+              "{} {}".format(host, port), flush=True)
+        c.train(stream_partitions(args.stream_rows, args.stream_interval,
+                                  args.max_batches))
+        c.shutdown(grace_secs=5)
+    finally:
+        b.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,132 @@
+"""U-Net image segmentation (reference ``examples/segmentation/segmentation_spark.py``).
+
+The reference trains a MobileNetV2+pix2pix U-Net on oxford_iiit_pet inside
+the cluster lifecycle (reference ``segmentation_spark.py:70-122``), with the
+chief exporting after training while non-chiefs idle through the export
+window (``segmentation_spark.py:162-173``).  This example drives the
+framework's encoder/decoder U-Net on synthetic shape-mask data (dataset
+download is out of scope offline) through the same lifecycle: FILES-mode
+cluster, per-pixel loss, chief-convention export — no sleep workaround
+needed, the shutdown grace period covers the export (framework behavior,
+reference ``TFSparkNode.py:542-545``).
+
+Run (CPU mesh; tiny smoke):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/segmentation/segmentation.py --cluster_size 2 \
+        --train_steps 2 --batch_size 8 --image_size 32
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def synthetic_pets(n, size, seed=23):
+    """Images with a bright rectangle on noise; masks label the rectangle
+    (3 classes like oxford_iiit_pet: object / border / background)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    images = rng.random((n, size, size, 3)).astype("float32") * 0.3
+    masks = np.full((n, size, size), 2, np.int32)  # background
+    for i in range(n):
+        h, w = rng.integers(size // 4, size // 2, 2)
+        y, x = rng.integers(0, size - h), rng.integers(0, size - w)
+        images[i, y:y + h, x:x + w] += 0.6
+        masks[i, y:y + h, x:x + w] = 0               # object
+        masks[i, y:y + h, x] = masks[i, y:y + h, x + w - 1] = 1  # border
+        masks[i, y, x:x + w] = masks[i, y + h - 1, x:x + w] = 1
+    return np.clip(images, 0, 1), masks
+
+
+def main_fun(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import unet as unet_mod
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    ctx.initialize_distributed()
+    mesh = mesh_mod.build_mesh()
+
+    images, masks = synthetic_pets(args.synthetic_examples, args.image_size)
+    shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
+    images, masks = images[shard], masks[shard]
+
+    model = unet_mod.build_unet(num_classes=3, dtype=args.dtype)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, args.image_size, args.image_size, 3)))["params"]
+    trainer = train_mod.Trainer(
+        unet_mod.loss_fn(model), params, optax.adam(args.lr), mesh=mesh,
+        compute_dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+        batch_size=args.batch_size, log_steps=args.log_steps)
+
+    local_bs = mesh_mod.local_batch_size(mesh, args.batch_size)
+    sharding = mesh_mod.batch_sharding(mesh)
+    rng = np.random.default_rng(jax.process_index())
+    loss = aux = None
+    step = 0
+    while step < args.train_steps:
+        order = rng.permutation(len(images))
+        for s in range(len(images) // local_bs):
+            idx = order[s * local_bs:(s + 1) * local_bs]
+            batch = {
+                "image": jax.make_array_from_process_local_data(
+                    sharding, images[idx]),
+                "mask": jax.make_array_from_process_local_data(
+                    sharding, masks[idx]),
+            }
+            row_mask = jax.make_array_from_process_local_data(
+                sharding, np.ones((local_bs,), np.float32))
+            loss, aux = trainer.step(batch, row_mask)
+            step += 1
+            if step >= args.train_steps:
+                break
+
+    trainer.history.on_train_end()
+    stats = trainer.history.log_stats(
+        loss=float(loss), accuracy=float(aux["accuracy"]))
+    if args.export_dir and checkpoint.should_export(ctx):
+        checkpoint.export_model(
+            ctx.absolute_path(args.export_dir),
+            jax.device_get(trainer.state.params), "unet",
+            model_config={"num_classes": 3, "dtype": args.dtype},
+            input_signature={
+                "image": [None, args.image_size, args.image_size, 3]})
+    return stats
+
+
+def main(argv=None):
+    from tensorflowonspark_tpu import backend, cluster
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cluster_size", type=int, default=2)
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--train_steps", type=int, default=200)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--image_size", type=int, default=128)
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["float32", "bfloat16"])
+    parser.add_argument("--synthetic_examples", type=int, default=512)
+    parser.add_argument("--export_dir", default=None)
+    parser.add_argument("--log_steps", type=int, default=20)
+    args, _ = parser.parse_known_args(argv)
+
+    b = backend.LocalBackend(args.cluster_size)
+    try:
+        c = cluster.run(b, main_fun, args, num_executors=args.cluster_size,
+                        input_mode=cluster.InputMode.FILES)
+        c.shutdown(grace_secs=2)
+    finally:
+        b.stop()
+
+
+if __name__ == "__main__":
+    main()

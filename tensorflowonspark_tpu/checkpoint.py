@@ -28,6 +28,19 @@ _DESCRIPTOR = "export.json"
 _PARAMS_DIR = "params"
 
 
+def _fs_path(path):
+    """Resolve a (possibly ``file://``-prefixed) path for local-fs IO.
+
+    ``ctx.absolute_path`` hands out ``file://`` URIs (reference ``hdfs_path``
+    convention); strip the scheme so ``os`` / ``open`` treat it as the local
+    path it names.  Other schemes (``gs://`` etc.) pass through for
+    orbax-compatible stores.
+    """
+    if path.startswith("file://"):
+        return path[len("file://"):]
+    return path if "://" in path else os.path.abspath(path)
+
+
 class CheckpointManager(object):
     """Chief-only periodic checkpointing of a train-state pytree.
 
@@ -47,7 +60,7 @@ class CheckpointManager(object):
                  is_chief=True):
         import orbax.checkpoint as ocp
 
-        self.directory = os.path.abspath(directory)
+        self.directory = _fs_path(directory)
         self.is_chief = is_chief
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -67,6 +80,8 @@ class CheckpointManager(object):
         if not force and (not self.save_interval_steps
                           or step % self.save_interval_steps != 0):
             return False  # interval 0 means explicit (force=True) saves only
+        if step == self._mgr.latest_step():
+            return False  # already saved (e.g. final force after interval hit)
         import orbax.checkpoint as ocp
 
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
@@ -95,28 +110,48 @@ class CheckpointManager(object):
         self._mgr.close()
 
 
+def should_export(ctx):
+    """Who calls :func:`export_model` under the chief-only convention.
+
+    - Single-process worlds (each executor its own jax runtime, e.g.
+      InputMode.SPARK without ``initialize_distributed``): chief only —
+      others writing the same dir would race.
+    - Multi-process worlds (``ctx.initialize_distributed()`` ran): EVERY
+      process — the orbax save is a cross-process collective (all hosts
+      contribute shards + sync barrier); gating on chiefness would crash
+      or deadlock the collective.  Only the primary actually writes.
+    """
+    import jax
+
+    return jax.process_count() > 1 or ctx.is_chief()
+
+
 def export_model(export_dir, params, model_name, model_config=None,
                  input_signature=None):
-    """Export params + model descriptor for serving (chief-only call).
+    """Export params + model descriptor for serving.
 
+    Call according to :func:`should_export` (chief-only convention,
+    reference ``mnist_spark.py:68-72``; collective in multi-process worlds).
     The pipeline's model-transform path loads this on executors that have the
     framework's model zoo but no user code — the portability role SavedModel
     played for the reference (``pipeline.py:474-481``).
     """
+    import jax
     import orbax.checkpoint as ocp
 
-    export_dir = os.path.abspath(export_dir)
+    export_dir = _fs_path(export_dir)
     os.makedirs(export_dir, exist_ok=True)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(export_dir, _PARAMS_DIR), params, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
-    with open(os.path.join(export_dir, _DESCRIPTOR), "w") as f:
-        json.dump({
-            "model_name": model_name,
-            "model_config": model_config or {},
-            "input_signature": input_signature or {},
-        }, f)
+    if jax.process_index() == 0:
+        with open(os.path.join(export_dir, _DESCRIPTOR), "w") as f:
+            json.dump({
+                "model_name": model_name,
+                "model_config": model_config or {},
+                "input_signature": input_signature or {},
+            }, f)
     logger.info("exported %s to %s", model_name, export_dir)
 
 
@@ -124,7 +159,7 @@ def load_model(export_dir):
     """Load an export: returns ``(params, descriptor_dict)``."""
     import orbax.checkpoint as ocp
 
-    export_dir = os.path.abspath(export_dir)
+    export_dir = _fs_path(export_dir)
     with open(os.path.join(export_dir, _DESCRIPTOR)) as f:
         descriptor = json.load(f)
     ckptr = ocp.StandardCheckpointer()

@@ -1,0 +1,118 @@
+"""Standalone batch-inference CLI (reference ``Inference.scala`` + ``TFModel.scala``).
+
+The reference ships a JVM-only serving path: a ``spark-submit``-able main
+that loads TFRecords (with an optional ``--schema_hint``), feeds them
+through a cached SavedModel session with JSON input/output mappings, and
+writes predictions as JSON (reference ``Inference.scala:27-79``,
+``TFModel.scala:245-292``).  This is its first-party equivalent over the
+framework export: TFRecords via the C++ codec, the model rebuilt from the
+export descriptor, batched jit inference, JSON-lines output — no JVM, no
+user code on the serving host.
+
+Usage:
+    python -m tensorflowonspark_tpu.inference_cli \
+        --export_dir /path/to/export --input /path/to/tfrecords \
+        --schema_hint 'struct<image:array<float>,label:bigint>' \
+        --input_mapping '{"image": "image"}' \
+        --output /path/to/preds.jsonl
+"""
+
+import argparse
+import json
+import logging
+import sys
+
+import numpy as np
+
+from tensorflowonspark_tpu import dfutil, schema as schema_mod
+
+logger = logging.getLogger(__name__)
+
+
+def run_inference(export_dir, rows, input_mapping=None, output_name="prediction",
+                  batch_size=128, input_signature=None):
+    """Yield one output row dict per input row (1:1 contract, reference
+    ``TFModel.scala:265-281`` / ``pipeline.py:509-512``)."""
+    import jax
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.models import get_model
+
+    params, desc = checkpoint.load_model(export_dir)
+    model = get_model(desc["model_name"], **desc.get("model_config", {}))
+    signature = input_signature or desc.get("input_signature") or {}
+    apply_fn = jax.jit(lambda p, x: model.apply({"params": p}, x))
+
+    if input_mapping:
+        (in_col, tensor_name), = input_mapping.items()  # single-input models
+    else:
+        in_col = next(iter(signature)) if signature else None
+
+    shape = signature.get(in_col) if in_col in (signature or {}) else (
+        next(iter(signature.values())) if signature else None)
+
+    for lo in range(0, len(rows), batch_size):
+        chunk = rows[lo:lo + batch_size]
+        if in_col is not None and isinstance(chunk[0], dict):
+            x = np.asarray([r[in_col] for r in chunk], np.float32)
+        else:
+            x = np.asarray(chunk, np.float32)
+        if shape is not None:
+            x = x.reshape([-1] + list(shape[1:]))
+        count = len(chunk)
+        if count < batch_size:
+            pad = [(0, batch_size - count)] + [(0, 0)] * (x.ndim - 1)
+            x = np.pad(x, pad)
+        preds = np.asarray(apply_fn(params, x))[:count]
+        for row, pred in zip(chunk, preds):
+            out = dict(row) if isinstance(row, dict) else {}
+            out[output_name] = pred.tolist()
+            yield out
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Batch inference over TFRecords with a framework export "
+                    "(reference Inference.scala)")
+    parser.add_argument("--export_dir", required=True)
+    parser.add_argument("--input", required=True,
+                        help="TFRecord directory")
+    parser.add_argument("--schema_hint", default=None,
+                        help="struct<name:type,...> (reference --schema_hint)")
+    parser.add_argument("--input_mapping", default=None,
+                        help='JSON {"column": "tensor"} (reference -i)')
+    parser.add_argument("--output_mapping", default=None,
+                        help='JSON {"tensor": "column"}; the single output '
+                             "column name (reference -o)")
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--output", default=None,
+                        help="output JSON-lines path (stdout when omitted)")
+    args = parser.parse_args(argv)
+
+    hint = schema_mod.parse(args.schema_hint) if args.schema_hint else None
+    input_mapping = json.loads(args.input_mapping) if args.input_mapping else None
+    output_name = "prediction"
+    if args.output_mapping:
+        output_name = next(iter(json.loads(args.output_mapping).values()))
+
+    rows = dfutil.load_tfrecords(args.input, schema=hint)
+    logger.info("loaded %d rows from %s (schema %s)",
+                len(rows), args.input, rows.schema)
+
+    out_f = open(args.output, "w") if args.output else sys.stdout
+    try:
+        n = 0
+        for out in run_inference(args.export_dir, rows,
+                                 input_mapping=input_mapping,
+                                 output_name=output_name,
+                                 batch_size=args.batch_size):
+            out_f.write(json.dumps(out) + "\n")
+            n += 1
+        logger.info("wrote %d predictions", n)
+    finally:
+        if args.output:
+            out_f.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -131,6 +131,12 @@ class ShardedFeed(object):
         finally:
             stop.set()  # wind the prefetch thread down on any exit path
 
+    def terminate(self):
+        """Terminate feeding early (training hit max steps with data left):
+        marks the node terminating and drains the input queue so blocked
+        feeders unblock (reference ``TFNode.terminate``, ``TFNode.py:172-194``)."""
+        self.feed.terminate()
+
     def _local_iter(self):
         """Yields (arrays, count) per step, then a single None at end-of-feed.
 

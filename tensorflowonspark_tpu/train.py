@@ -148,6 +148,12 @@ class Trainer(object):
             last_loss = loss
             steps_done += 1
             if max_steps and steps_done >= max_steps:
+                # Early stop with epochs of data still queued: drain it so
+                # blocked feed tasks unblock and the driver stops scheduling
+                # more partitions (reference StopFeedHook/terminate pattern,
+                # estimator/mnist_spark.py:14-22 + TFNode.py:172-194).
+                if hasattr(sharded_feed, "terminate"):
+                    sharded_feed.terminate()
                 break
         if self.history:
             self.history.on_train_end()

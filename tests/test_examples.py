@@ -1,0 +1,103 @@
+"""Examples-layer smoke tests: run each example's real CLI entry point with
+tiny settings on the virtual CPU mesh, the way the reference CI exercises
+its examples (reference ``examples/resnet/*_test.py`` runs
+``-use_synthetic_data -train_steps 1 -batch_size 4``)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def run_example(rel, argv, timeout=280):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "PYTHONPATH": os.path.abspath(os.path.join(EXAMPLES, "..")),
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, rel)] + argv,
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    return proc.stdout + proc.stderr
+
+
+def test_mnist_spark_trains_and_exports(tmp_path):
+    export = str(tmp_path / "export")
+    out = run_example("mnist/mnist_spark.py",
+                      ["--cluster_size", "2", "--epochs", "1",
+                       "--max_steps", "4", "--export_dir", export])
+    assert "train stats" in out
+    assert os.path.exists(os.path.join(export, "export.json"))
+
+
+def test_mnist_files_checkpoint_and_inference(tmp_path):
+    export = str(tmp_path / "export")
+    out = run_example("mnist/mnist_files.py",
+                      ["--cluster_size", "2", "--epochs", "1",
+                       "--max_steps", "4", "--save_interval", "2",
+                       "--model_dir", str(tmp_path / "ckpt"),
+                       "--export_dir", export])
+    assert "train stats" in out
+    assert os.listdir(str(tmp_path / "ckpt")), "no checkpoints written"
+    out = run_example("mnist/mnist_inference.py",
+                      ["--cluster_size", "2", "--export_dir", export])
+    assert "accuracy:" in out
+
+
+def test_mnist_streaming_bounded(tmp_path):
+    out = run_example("mnist/mnist_streaming.py",
+                      ["--cluster_size", "2", "--max_batches", "4",
+                       "--stream_interval", "0.02"])
+    assert "train stats" in out
+
+
+def test_resnet_cifar_synthetic():
+    out = run_example("resnet/resnet_cifar.py",
+                      ["--cluster_size", "2", "--use_synthetic_data",
+                       "--train_steps", "2", "--batch_size", "32",
+                       "--synthetic_examples", "64"])
+    assert "train stats" in out
+
+
+def test_segmentation_synthetic():
+    out = run_example("segmentation/segmentation.py",
+                      ["--cluster_size", "2", "--train_steps", "2",
+                       "--batch_size", "16", "--image_size", "32",
+                       "--synthetic_examples", "64"])
+    assert "train stats" in out
+
+
+def test_transformer_lm_3d_mesh():
+    out = run_example("transformer/transformer_lm.py",
+                      ["--cluster_size", "1", "--data", "2", "--seq", "2",
+                       "--tensor", "2", "--seq_len", "128",
+                       "--num_layers", "2", "--batch_size", "4",
+                       "--train_steps", "2"])
+    assert "train stats" in out
+
+
+def test_mnist_data_setup_roundtrip(tmp_path):
+    run_example("mnist/mnist_data_setup.py",
+                ["--output", str(tmp_path), "--num_partitions", "2"],
+                timeout=600)
+    assert os.path.exists(str(tmp_path / "csv" / "train" / "part-00000.csv"))
+    assert os.path.exists(str(tmp_path / "tfr" / "test" / "part-r-00000"))
+    from tensorflowonspark_tpu import dfutil
+
+    rows = dfutil.load_tfrecords(str(tmp_path / "tfr" / "test"))
+    assert len(rows) == 10000
+    assert rows.schema == {"image": "array<float32>", "label": "int64"}
+
+
+@pytest.mark.slow
+def test_mnist_pipeline_end_to_end():
+    out = run_example("mnist/mnist_pipeline.py",
+                      ["--cluster_size", "2", "--epochs", "1",
+                       "--batch_size", "256"], timeout=560)
+    assert "pipeline accuracy" in out

@@ -1,0 +1,95 @@
+"""Schema-string parser tests (reference ``SimpleTypeParserTest.scala``) and
+the batch-inference CLI (reference ``Inference.scala``)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import dfutil, schema
+
+
+class TestParse:
+    def test_scalars(self):
+        out = schema.parse("struct<a:int,b:bigint,c:float,d:double,"
+                           "e:string,f:binary,g:boolean>")
+        assert out == {"a": "int64", "b": "int64", "c": "float32",
+                       "d": "float32", "e": "string", "f": "binary",
+                       "g": "int64"}
+
+    def test_arrays(self):
+        out = schema.parse("struct<x:array<float>,y:array<bigint>>")
+        assert out == {"x": "array<float32>", "y": "array<int64>"}
+
+    def test_whitespace_and_case(self):
+        out = schema.parse("  STRUCT< a : INT , b : ARRAY<STRING> >  ")
+        assert out == {"a": "int64", "b": "array<string>"}
+
+    def test_empty_struct(self):
+        assert schema.parse("struct<>") == {}
+
+    def test_order_preserved(self):
+        out = schema.parse("struct<z:int,a:int,m:int>")
+        assert list(out) == ["z", "a", "m"]
+
+    @pytest.mark.parametrize("bad", [
+        "notastruct",
+        "struct<a>",
+        "struct<a:unknowntype>",
+        "struct<a:array<array<int>>>",
+        "struct<a:int,a:float>",
+        "struct<1bad:int>",
+        "struct<a:array<int>",
+    ])
+    def test_rejects(self, bad):
+        with pytest.raises(schema.SchemaParseError):
+            schema.parse(bad)
+
+
+def test_inference_cli_end_to_end(tmp_path):
+    """TFRecords + linear export -> CLI -> JSON-lines predictions."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.models import get_model
+
+    # export a linear model with known weights
+    model = get_model("linear")
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 2)))["params"]
+    params = jax.tree_util.tree_map(np.asarray, params)
+    params = {"dense": {"kernel": np.asarray([[2.0], [3.0]], np.float32),
+                        "bias": np.zeros((1,), np.float32)}}
+    export_dir = str(tmp_path / "export")
+    checkpoint.export_model(export_dir, params, "linear",
+                            model_config={"features": 1},
+                            input_signature={"x": [None, 2]})
+
+    rows = [{"x": [1.0, 1.0]}, {"x": [2.0, 0.5]}, {"x": [0.0, 0.0]}]
+    data_dir = str(tmp_path / "tfr")
+    dfutil.save_as_tfrecords(rows, data_dir,
+                             schema={"x": "array<float32>"})
+
+    out_path = str(tmp_path / "preds.jsonl")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+    proc = subprocess.run(
+        [sys.executable, "-m", "tensorflowonspark_tpu.inference_cli",
+         "--export_dir", export_dir, "--input", data_dir,
+         "--schema_hint", "struct<x:array<float>>",
+         "--input_mapping", json.dumps({"x": "x"}),
+         "--output_mapping", json.dumps({"y": "score"}),
+         "--batch_size", "2", "--output", out_path],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    preds = [json.loads(line) for line in open(out_path)]
+    assert len(preds) == 3
+    want = [5.0, 5.5, 0.0]
+    for row, expect in zip(preds, want):
+        assert abs(row["score"][0] - expect) < 1e-4
+        assert "x" in row  # input columns carried through

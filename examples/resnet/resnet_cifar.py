@@ -93,6 +93,15 @@ def main_fun(args, ctx):
             ctx.absolute_path(args.model_dir),
             save_interval_steps=args.save_interval)
 
+    # --profile_steps "start,stop" captures a device trace over that range
+    # (reference common.py:192-197,293-300).
+    prof = None
+    if args.profile_steps:
+        from tensorflowonspark_tpu import profiler
+
+        prof = profiler.StepProfiler(
+            args.profile_dir or "profile_logs", args.profile_steps)
+
     local_bs = mesh_mod.local_batch_size(mesh, args.batch_size)
     sharding = mesh_mod.batch_sharding(mesh)
     rng = np.random.default_rng(jax.process_index())
@@ -115,13 +124,19 @@ def main_fun(args, ctx):
             }
             mask = jax.make_array_from_process_local_data(
                 sharding, np.ones((local_bs,), np.float32))
+            if prof:
+                prof.on_step_begin()
             loss, aux = trainer.step(batch, mask)
+            if prof:
+                prof.on_step_end()
             step += 1
             if ckpt:
                 ckpt.maybe_save(step, jax.device_get(trainer.state))
             if step >= total_steps:
                 break
 
+    if prof:
+        prof.stop()
     trainer.history.on_train_end()
     stats = trainer.history.log_stats(
         loss=float(loss), accuracy=float(aux["accuracy"]))
@@ -162,6 +177,10 @@ def main(argv=None):
     parser.add_argument("--export_dir", default=None)
     parser.add_argument("--save_interval", type=int, default=500)
     parser.add_argument("--log_steps", type=int, default=20)
+    parser.add_argument("--profile_steps", default=None,
+                        help='"start,stop" device-trace capture range '
+                             "(reference --profile_steps)")
+    parser.add_argument("--profile_dir", default=None)
     # parse_known_args: leftover argv rides along inside args for user code
     # (reference passthrough convention, resnet_cifar_spark.py:19-21)
     args, rem = parser.parse_known_args(argv)

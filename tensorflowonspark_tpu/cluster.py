@@ -215,12 +215,19 @@ class TPUCluster(object):
                 return "http://{}:{}".format(n["host"], n["tb_port"])
         return None
 
+    def profiler_addresses(self):
+        """Per-host jax.profiler server addresses (``cluster.run(...,
+        profiler=True)``); feed one to TensorBoard's profile-plugin capture
+        dialog or ``jax.profiler.trace_remote``."""
+        return ["{}:{}".format(n["host"], n["profiler_port"])
+                for n in self.cluster_info if n.get("profiler_port")]
+
 
 def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         tensorboard=False, input_mode=InputMode.FILES, log_dir=None,
         master_node=None, reservation_timeout=600,
         queues=("input", "output", "error"), eval_node=False,
-        release_port=True):
+        release_port=True, profiler=False):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -285,7 +292,8 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
     background = (input_mode == InputMode.SPARK)
     start_fn = node.run(map_fun, tf_args, cluster_meta, tensorboard=tensorboard,
                         log_dir=log_dir, queues=tuple(queues),
-                        background=background, release_port=release_port)
+                        background=background, release_port=release_port,
+                        profiler=profiler)
     start_parts = backend_mod.partition(range(num_executors), num_executors)
     start_job = cluster_backend.foreach_partition_async(start_parts, start_fn)
 

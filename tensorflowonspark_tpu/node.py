@@ -167,7 +167,7 @@ def _sort_key(node):
 
 def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         queues=("input", "output", "error"), background=False,
-        release_port=True):
+        release_port=True, profiler=False):
     """Build the "start job" task closure (reference ``TFSparkNode.py:121-368``).
 
     Args:
@@ -258,6 +258,14 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         if tensorboard and job_name in ("chief", "master", "worker") and task_index == 0:
             tb_pid, tb_port = _start_tensorboard(log_dir or "tensorboard_logs")
 
+        # Per-host jax.profiler server so TensorBoard's profile plugin can
+        # capture device traces on demand (SURVEY §5.1 TPU mapping).
+        profiler_port = 0
+        if profiler and job_name in _JAX_JOBS:
+            from tensorflowonspark_tpu import profiler as profiler_mod
+
+            profiler_port = profiler_mod.start_server()
+
         # Reserve the port this node contributes to the roster.  For process 0
         # it becomes the jax.distributed coordinator port (reference reserved
         # the TF gRPC server port here, TFSparkNode.py:239-244).
@@ -276,6 +284,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             "pid": os.getpid(),
             "tb_pid": tb_pid,
             "tb_port": tb_port,
+            "profiler_port": profiler_port,
             "working_dir": os.getcwd(),
         }
         client.register(node_meta)

@@ -1,0 +1,104 @@
+"""Profiling lifecycle (reference SURVEY §5.1).
+
+The reference's tracing story is TensorBoard managed by the framework
+(launch on the chief, URL via the cluster, kill at shutdown — implemented in
+:mod:`~tensorflowonspark_tpu.node`) plus example-level step profiling
+(``--profile_steps`` building a Keras profiler callback, reference
+``examples/resnet/common.py:192-197,293-300``).  The TPU-native equivalents:
+
+- :func:`start_server` — a per-host ``jax.profiler`` server so TensorBoard's
+  profile plugin (or ``xprof``) can capture device traces on demand; the
+  node runtime starts one per JAX-hosting node when ``cluster.run(...,
+  profiler=True)`` and publishes the port in the cluster roster.
+- :class:`StepProfiler` — programmatic trace capture over a step range,
+  the ``--profile_steps start,stop`` behavior: call :meth:`on_step_end`
+  once per step and the trace for [start, stop] lands in ``log_dir``.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def start_server(port=None):
+    """Start this process's jax.profiler gRPC server; returns the port
+    (0 when jax lacks profiler support).  Idempotent per process — jax
+    allows one server; subsequent calls return the first port."""
+    global _server_port
+    if _server_port is not None:
+        return _server_port
+    import jax
+
+    if port is None:
+        import socket
+
+        sock = socket.socket()
+        sock.bind(("", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+    try:
+        jax.profiler.start_server(port)
+    except Exception:
+        logger.warning("jax profiler server unavailable", exc_info=True)
+        _server_port = 0
+        return 0
+    _server_port = port
+    logger.info("jax profiler server listening on port %d", port)
+    return port
+
+
+_server_port = None
+
+
+def parse_profile_steps(spec):
+    """``"start,stop"`` -> (start, stop) step numbers (reference flag format,
+    ``common.py:293-300``)."""
+    if not spec:
+        return None
+    parts = [p.strip() for p in str(spec).split(",")]
+    if len(parts) != 2:
+        raise ValueError(
+            "profile_steps must be 'start,stop', got {!r}".format(spec))
+    start, stop = int(parts[0]), int(parts[1])
+    if start < 0 or stop < start:
+        raise ValueError(
+            "need 0 <= start <= stop in profile_steps, got {!r}".format(spec))
+    return start, stop
+
+
+class StepProfiler(object):
+    """Capture a device trace over a global-step range.
+
+    Usage: ``prof = StepProfiler(log_dir, "10,20")`` then call
+    ``prof.on_step_end()`` after every step (mirrors
+    :class:`~tensorflowonspark_tpu.metrics.TimeHistory`); the trace starts
+    before step ``start`` executes and stops after step ``stop``.
+    """
+
+    def __init__(self, log_dir, profile_steps):
+        self.log_dir = log_dir
+        self.bounds = parse_profile_steps(profile_steps)
+        self.step = 0
+        self._active = False
+
+    def on_step_begin(self):
+        if self.bounds and not self._active and self.step == self.bounds[0]:
+            import jax
+
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            logger.info("profiler trace started at step %d -> %s",
+                        self.step, self.log_dir)
+
+    def on_step_end(self):
+        self.step += 1
+        if self._active and self.step > self.bounds[1]:
+            self.stop()
+
+    def stop(self):
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            logger.info("profiler trace stopped at step %d", self.step)

@@ -82,6 +82,16 @@ class DataFeed(object):
             [tensor for _, tensor in sorted(input_mapping.items())]
             if input_mapping is not None else None
         )
+        # Unpacked-but-unconsumed items from the last Chunk (feeders send
+        # chunks to amortize the per-element IPC hop; see marker.Chunk).
+        # The chunk's task_done is DEFERRED until its last item is handed
+        # out (_chunk_q holds the pending ack): a consumer crashing
+        # mid-chunk must leave the queue un-joined so the feeder's
+        # error-poll fires, matching the reference's per-item fail-fast
+        # semantics (reference TFSparkNode.py:407-418).
+        self._buffer = []
+        self._buffer_idx = 0
+        self._chunk_q = None
 
     def next_batch(self, batch_size):
         """Get up to ``batch_size`` items from the input queue.
@@ -99,18 +109,35 @@ class DataFeed(object):
                    else {tensor: [] for tensor in self.input_tensors})
         count = 0
         while count < batch_size:
-            item = queue.get(block=True)
+            if self._buffer_idx < len(self._buffer):
+                item = self._buffer[self._buffer_idx]
+                self._buffer_idx += 1
+                if self._buffer_idx >= len(self._buffer):
+                    self._ack_chunk()  # last buffered item handed out
+                from_queue = False
+            else:
+                item = queue.get(block=True)
+                from_queue = True
+                if isinstance(item, marker.Chunk):
+                    # Unpack into the local buffer; ack deferred (see ctor).
+                    self._buffer, self._buffer_idx = item.items, 0
+                    self._chunk_q = queue
+                    if not item.items:
+                        self._ack_chunk()
+                    continue
             if item is None:
                 # End-of-feed: producers are done for good (reference 129-134).
                 logger.info("next_batch: end of feed")
                 self.done_feeding = True
-                queue.task_done()
+                if from_queue:
+                    queue.task_done()
                 break
             elif isinstance(item, marker.EndPartition):
                 # Partition boundary: stop here if we already have items so
                 # result batches align with partitions (reference 135-140).
                 logger.debug("next_batch: end of partition")
-                queue.task_done()
+                if from_queue:
+                    queue.task_done()
                 if count > 0:
                     break
             else:
@@ -120,9 +147,15 @@ class DataFeed(object):
                     for i, tensor in enumerate(self.input_tensors):
                         tensors[tensor].append(item[i])
                 count += 1
-                queue.task_done()
+                if from_queue:
+                    queue.task_done()
         logger.debug("next_batch: returning %d items", count)
         return tensors
+
+    def _ack_chunk(self):
+        if self._chunk_q is not None:
+            self._chunk_q.task_done()
+            self._chunk_q = None
 
     def next_batch_arrays(self, batch_size, dtypes=None):
         """TPU-first variant: assemble the batch directly into numpy arrays.
@@ -152,10 +185,12 @@ class DataFeed(object):
 
     def batch_results(self, results):
         """Push a batch of inference results to the output queue
-        (reference ``TFNode.py:157-170``)."""
-        queue = self.mgr.get_queue(self.qname_out)
-        for item in results:
-            queue.put(item, block=True)
+        (reference ``TFNode.py:157-170``); the whole batch travels as one
+        chunk (see :class:`~tensorflowonspark_tpu.marker.Chunk`)."""
+        results = list(results)
+        if results:
+            queue = self.mgr.get_queue(self.qname_out)
+            queue.put(marker.Chunk(results), block=True)
 
     def terminate(self):
         """Terminate data feeding early (e.g. training reached max steps with
@@ -164,6 +199,8 @@ class DataFeed(object):
         (reference ``TFNode.py:172-194``)."""
         logger.info("terminate() invoked: draining remaining input")
         self.mgr.set("state", "terminating")
+        self._ack_chunk()  # release a partially-consumed chunk's join hold
+        self._buffer, self._buffer_idx = [], 0
         queue = self.mgr.get_queue(self.qname_in)
         count = 0
         done = False

@@ -16,3 +16,19 @@ class Marker(object):
 
 class EndPartition(Marker):
     """Marks the end of one input partition within the feed queue."""
+
+
+class Chunk(Marker):
+    """A block of consecutive items travelling as ONE queue element.
+
+    TPU-first addition: the reference paid one manager-proxy round trip per
+    example (the InputMode.SPARK throughput ceiling, SURVEY §3.2); feeders
+    here put :class:`Chunk` blocks so the per-element IPC cost amortizes over
+    ``len(items)``.  :class:`~tensorflowonspark_tpu.datafeed.DataFeed`
+    unpacks chunks transparently — consumers still see items.
+    """
+
+    __slots__ = ("items",)
+
+    def __init__(self, items):
+        self.items = items

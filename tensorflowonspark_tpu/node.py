@@ -408,9 +408,15 @@ def _get_manager(cluster_info, host, executor_id):
             executor_id, host))
 
 
-def train(cluster_info, cluster_meta, qname="input", feed_timeout=600):
+def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
+          chunk_size=256):
     """Feed-job closure: push partition items into this executor's input queue
-    (reference ``TFSparkNode.py:371-438``)."""
+    (reference ``TFSparkNode.py:371-438``).
+
+    Items travel in :class:`~tensorflowonspark_tpu.marker.Chunk` blocks of
+    ``chunk_size`` so the manager-proxy IPC cost amortizes (the reference's
+    per-element hops were its feed ceiling, SURVEY §3.2); backpressure is at
+    chunk granularity via the JoinableQueue."""
 
     def _train(iterator):
         host = util.get_ip_address()
@@ -426,9 +432,15 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600):
             logger.info("skipped %d items", count)
         else:
             count = 0
+            block = []
             for item in iterator:
-                queue.put(item, block=True)  # backpressure via JoinableQueue
+                block.append(item)
                 count += 1
+                if len(block) >= chunk_size:
+                    queue.put(marker.Chunk(block), block=True)
+                    block = []
+            if block:
+                queue.put(marker.Chunk(block), block=True)
             # Wait for the consumer to drain the queue, surfacing user-code
             # errors and enforcing feed_timeout (reference TFSparkNode.py:407-418).
             _join_with_error_check(mgr, queue, feed_timeout, "feeding")
@@ -495,9 +507,15 @@ def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
         queue_in = mgr.get_queue(qname_in)
 
         count = 0
+        block = []
         for item in iterator:
-            queue_in.put(item, block=True)
+            block.append(item)
             count += 1
+            if len(block) >= 256:
+                queue_in.put(marker.Chunk(block), block=True)
+                block = []
+        if block:
+            queue_in.put(marker.Chunk(block), block=True)
         # Signal end-of-partition so DataFeed can align result batches
         # (reference TFSparkNode.py:469, marker.py).
         queue_in.put(marker.EndPartition(), block=True)
@@ -511,9 +529,13 @@ def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
         results = []
         while count > 0:
             result = queue_out.get(block=True)
-            results.append(result)
-            count -= 1
             queue_out.task_done()
+            if isinstance(result, marker.Chunk):
+                results.extend(result.items)
+                count -= len(result.items)
+            else:
+                results.append(result)
+                count -= 1
         return results
 
     return _inference

@@ -66,7 +66,24 @@ class TestDataFeed:
         feed = DataFeed(mgr, train_mode=False)
         feed.batch_results([10, 20, 30])
         out = mgr.get_queue("output")
-        assert [out.get() for _ in range(3)] == [10, 20, 30]
+        chunk = out.get()  # whole batch travels as one Chunk
+        assert isinstance(chunk, marker.Chunk)
+        assert chunk.items == [10, 20, 30]
+
+    def test_chunked_feed_transparent(self, mgr):
+        # Feeders send Chunk blocks; consumers still see items, and markers
+        # (EndPartition / None) keep their alignment semantics.
+        q = mgr.get_queue("input")
+        q.put(marker.Chunk([0, 1, 2]))
+        q.put(marker.Chunk([3, 4]))
+        q.put(marker.EndPartition())
+        q.put(marker.Chunk([5, 6]))
+        q.put(None)
+        feed = DataFeed(mgr)
+        assert feed.next_batch(4) == [0, 1, 2, 3]
+        assert feed.next_batch(4) == [4]       # stops at partition boundary
+        assert feed.next_batch(4) == [5, 6]    # then end-of-feed
+        assert feed.should_stop()
 
     def test_terminate_drains(self, mgr):
         _feed(mgr, list(range(50)))

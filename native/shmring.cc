@@ -1,0 +1,256 @@
+// Shared-memory SPSC ring buffer: the native transport of the feed data
+// plane.  The reference's data plane crossed a multiprocessing-manager
+// socket per element (reference TFManager.py / TFNode.py:124-149, the
+// InputMode.SPARK ceiling); here bulk chunk payloads move through a
+// lock-free shared-memory ring between the feed task and the training
+// process on the same host, with only tiny ordering tokens left on the
+// manager queue (see tensorflowonspark_tpu/shmring.py for the protocol).
+//
+// Design: single producer, single consumer (the backend schedules feed
+// tasks sequentially per executor — one task slot, like the reference,
+// TFSparkNode.py:110-115).  Records are [u32 length][payload] packed
+// contiguously; a length of 0xFFFFFFFF is a wrap marker telling the reader
+// to jump back to offset 0.  head/tail are monotonically increasing byte
+// offsets (mod capacity for addressing) in a cache-line-separated header.
+// Blocking uses a bounded spin + nanosleep backoff — portable, and the
+// ~50us sleep is negligible against multi-KB chunk payloads.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <new>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x54464f53524e4731ULL;  // "TFOSRNG1"
+constexpr uint32_t kWrapMarker = 0xFFFFFFFFu;
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;                       // data region bytes
+  alignas(64) std::atomic<uint64_t> head;  // bytes written (monotonic)
+  alignas(64) std::atomic<uint64_t> tail;  // bytes consumed (monotonic)
+  alignas(64) std::atomic<uint64_t> closed;
+};
+
+struct Ring {
+  Header* hdr;
+  uint8_t* data;
+  uint64_t capacity;
+  size_t map_len;
+  bool owner;
+  char name[256];
+};
+
+void backoff(unsigned spins) {
+  if (spins < 64) return;  // busy spin first
+  struct timespec ts = {0, 50 * 1000};  // 50us
+  nanosleep(&ts, nullptr);
+}
+
+uint64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner) or attach to the ring named `name` (shm_open name, must
+// start with '/').  capacity is rounded up to a page multiple; pass 0 when
+// attaching.  Returns an opaque handle or null.
+void* shmring_create(const char* name, uint64_t capacity) {
+  long page = sysconf(_SC_PAGESIZE);
+  capacity = ((capacity + page - 1) / page) * page;
+  size_t map_len = sizeof(Header) + capacity;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, static_cast<off_t>(map_len)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  Header* hdr = new (mem) Header();
+  hdr->capacity = capacity;
+  hdr->head.store(0, std::memory_order_relaxed);
+  hdr->tail.store(0, std::memory_order_relaxed);
+  hdr->closed.store(0, std::memory_order_relaxed);
+  hdr->magic = kMagic;  // last: attachers spin on magic
+  Ring* r = new Ring();
+  r->hdr = hdr;
+  r->data = reinterpret_cast<uint8_t*>(mem) + sizeof(Header);
+  r->capacity = capacity;
+  r->map_len = map_len;
+  r->owner = true;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  r->name[sizeof(r->name) - 1] = 0;
+  return r;
+}
+
+void* shmring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < (off_t)sizeof(Header)) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* hdr = reinterpret_cast<Header*>(mem);
+  for (unsigned spins = 0; hdr->magic != kMagic; ++spins) {
+    if (spins > 200000) {  // ~10s: creator never finished initializing
+      munmap(mem, st.st_size);
+      return nullptr;
+    }
+    backoff(spins | 64);
+  }
+  Ring* r = new Ring();
+  r->hdr = hdr;
+  r->data = reinterpret_cast<uint8_t*>(mem) + sizeof(Header);
+  r->capacity = hdr->capacity;
+  r->map_len = st.st_size;
+  r->owner = false;
+  strncpy(r->name, name, sizeof(r->name) - 1);
+  r->name[sizeof(r->name) - 1] = 0;
+  return r;
+}
+
+// Write one record.  Returns 0 on success, -1 on timeout, -2 if closed,
+// -3 if the record can never fit (len + framing > capacity).
+int shmring_write(void* handle, const uint8_t* buf, uint64_t len,
+                  uint64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  if (len >= kWrapMarker) return -3;  // length header is 32-bit framing
+  const uint64_t need = len + 4;
+  if (need + 4 > r->capacity) return -3;  // +4: worst-case wrap marker
+  const uint64_t deadline = timeout_ms ? now_ms() + timeout_ms : 0;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  unsigned spins = 0;
+  for (;;) {
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    const uint64_t tail = h->tail.load(std::memory_order_acquire);
+    const uint64_t pos = head % r->capacity;
+    const uint64_t to_end = r->capacity - pos;
+    // Reserve a wrap marker too when the record would straddle the end.
+    const uint64_t reserve = (to_end < need) ? to_end + need : need;
+    if (reserve > r->capacity) return -3;  // can never fit at THIS offset:
+                                           // caller takes the queue fallback
+                                           // rather than starving forever
+    if (head + reserve - tail <= r->capacity) {
+      if (to_end < need) {
+        if (to_end >= 4) {
+          uint32_t wrap = kWrapMarker;
+          memcpy(r->data + pos, &wrap, 4);
+        }  // < 4 bytes left: reader detects the short tail itself
+        head += to_end;  // jump to start of ring
+      }
+      const uint64_t wpos = head % r->capacity;
+      uint32_t len32 = static_cast<uint32_t>(len);
+      memcpy(r->data + wpos, &len32, 4);
+      memcpy(r->data + wpos + 4, buf, len);
+      h->head.store(head + need, std::memory_order_release);
+      return 0;
+    }
+    if (deadline && now_ms() > deadline) return -1;
+    backoff(spins++);
+  }
+}
+
+// Size of the next record: >=0, -1 on timeout, -2 if closed and drained.
+int64_t shmring_next_len(void* handle, uint64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  const uint64_t deadline = timeout_ms ? now_ms() + timeout_ms : 0;
+  unsigned spins = 0;
+  for (;;) {
+    uint64_t tail = h->tail.load(std::memory_order_relaxed);
+    const uint64_t head = h->head.load(std::memory_order_acquire);
+    if (head != tail) {
+      uint64_t pos = tail % r->capacity;
+      const uint64_t to_end = r->capacity - pos;
+      if (to_end < 4) {  // unusable short tail: writer jumped to 0
+        h->tail.store(tail + to_end, std::memory_order_release);
+        continue;
+      }
+      uint32_t len32;
+      memcpy(&len32, r->data + pos, 4);
+      if (len32 == kWrapMarker) {  // explicit wrap marker
+        h->tail.store(tail + to_end, std::memory_order_release);
+        continue;
+      }
+      return static_cast<int64_t>(len32);
+    }
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    if (deadline && now_ms() > deadline) return -1;
+    backoff(spins++);
+  }
+}
+
+// Copy the next record into out (caller sized it via shmring_next_len) and
+// advance the tail.  Returns bytes copied.
+int64_t shmring_pop(void* handle, uint8_t* out, uint64_t out_len) {
+  Ring* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  const uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  const uint64_t pos = tail % r->capacity;
+  uint32_t len32;
+  memcpy(&len32, r->data + pos, 4);
+  if (len32 > out_len) return -1;
+  memcpy(out, r->data + pos + 4, len32);
+  h->tail.store(tail + 4 + len32, std::memory_order_release);
+  return static_cast<int64_t>(len32);
+}
+
+// Bytes currently buffered (approximate; racy by design).
+uint64_t shmring_fill(void* handle) {
+  Ring* r = static_cast<Ring*>(handle);
+  return r->hdr->head.load(std::memory_order_acquire) -
+         r->hdr->tail.load(std::memory_order_acquire);
+}
+
+void shmring_close(void* handle) {  // producer: no more writes
+  static_cast<Ring*>(handle)->hdr->closed.store(1, std::memory_order_release);
+}
+
+int shmring_closed(void* handle) {
+  return static_cast<Ring*>(handle)->hdr->closed.load(
+             std::memory_order_acquire) != 0;
+}
+
+void shmring_reopen(void* handle) {  // next feed job resumes writing
+  static_cast<Ring*>(handle)->hdr->closed.store(0, std::memory_order_release);
+}
+
+// Detach this handle's mapping.  Never unlinks: the object must stay
+// attachable for later feed tasks until the cluster explicitly unlinks it
+// at shutdown (shmring_unlink) — an implicit owner-unlink here would let a
+// subsequent create() produce a second ring under the same name while the
+// consumer still reads the first.
+void shmring_free(void* handle) {
+  Ring* r = static_cast<Ring*>(handle);
+  munmap(r->hdr, r->map_len);
+  delete r;
+}
+
+int shmring_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

@@ -112,12 +112,19 @@ class DataFeed(object):
             if self._buffer_idx < len(self._buffer):
                 item = self._buffer[self._buffer_idx]
                 self._buffer_idx += 1
-                if self._buffer_idx >= len(self._buffer):
-                    self._ack_chunk()  # last buffered item handed out
                 from_queue = False
             else:
                 item = queue.get(block=True)
                 from_queue = True
+                if isinstance(item, marker.ShmChunk):
+                    # Payload took the native shm-ring fast path; the token
+                    # preserves ordering/join semantics (see marker.ShmChunk).
+                    self._buffer = self._ring_read(item)
+                    self._buffer_idx = 0
+                    self._chunk_q = queue
+                    if not self._buffer:
+                        self._ack_chunk()
+                    continue
                 if isinstance(item, marker.Chunk):
                     # Unpack into the local buffer; ack deferred (see ctor).
                     self._buffer, self._buffer_idx = item.items, 0
@@ -149,6 +156,11 @@ class DataFeed(object):
                 count += 1
                 if from_queue:
                     queue.task_done()
+                elif self._buffer_idx >= len(self._buffer):
+                    # Ack only after the chunk's last item is safely batched:
+                    # a crash on a malformed item above must leave the queue
+                    # un-joined so the feeder's error-poll fires (see ctor).
+                    self._ack_chunk()
         logger.debug("next_batch: returning %d items", count)
         return tensors
 
@@ -156,6 +168,26 @@ class DataFeed(object):
         if self._chunk_q is not None:
             self._chunk_q.task_done()
             self._chunk_q = None
+
+    def _ring_read(self, token, timeout_secs=600):
+        """Pop one chunk payload from the shm ring named by the token."""
+        import pickle
+
+        from tensorflowonspark_tpu import shmring
+
+        ring = shmring.get_ring(token.ring_name)
+        if ring is None:
+            raise RuntimeError(
+                "feeder sent a shm-ring chunk but ring {} cannot be attached "
+                "in the consumer process".format(token.ring_name))
+        items = pickle.loads(ring.get_bytes(timeout_secs))
+        if len(items) != token.count:
+            # Token/record desync would silently deliver wrong training data;
+            # must survive python -O, so not an assert.
+            raise RuntimeError(
+                "shm ring {} desync: token promised {} items, record has "
+                "{}".format(token.ring_name, token.count, len(items)))
+        return items
 
     def next_batch_arrays(self, batch_size, dtypes=None):
         """TPU-first variant: assemble the batch directly into numpy arrays.
@@ -211,6 +243,13 @@ class DataFeed(object):
                 if item is None:
                     done = True
                 else:
+                    if isinstance(item, marker.ShmChunk):
+                        # Pop the ring record too, so a producer blocked on a
+                        # full ring unblocks (tokens and records stay 1:1).
+                        try:
+                            self._ring_read(item, timeout_secs=5)
+                        except Exception:
+                            pass
                     count += 1
             except _queue.Empty:
                 logger.info("dropped %d items after terminate", count)

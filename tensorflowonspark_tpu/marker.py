@@ -32,3 +32,18 @@ class Chunk(Marker):
 
     def __init__(self, items):
         self.items = items
+
+
+class ShmChunk(Marker):
+    """Ordering token for a chunk whose payload travels through the native
+    shared-memory ring (:mod:`~tensorflowonspark_tpu.shmring`) instead of
+    the manager socket.  The token keeps the JoinableQueue semantics
+    (ordering, backpressure, join/fail-fast) while the bytes take the fast
+    path; ``count`` is the number of items in the ring record.
+    """
+
+    __slots__ = ("ring_name", "count")
+
+    def __init__(self, ring_name, count):
+        self.ring_name = ring_name
+        self.count = count

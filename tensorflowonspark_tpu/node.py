@@ -431,16 +431,9 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
             count = sum(1 for _ in iterator)
             logger.info("skipped %d items", count)
         else:
-            count = 0
-            block = []
-            for item in iterator:
-                block.append(item)
-                count += 1
-                if len(block) >= chunk_size:
-                    queue.put(marker.Chunk(block), block=True)
-                    block = []
-            if block:
-                queue.put(marker.Chunk(block), block=True)
+            put = _chunk_putter(queue, cluster_meta, executor_id, qname,
+                                feed_timeout)
+            count = _feed_blocks(iterator, put, chunk_size)
             # Wait for the consumer to drain the queue, surfacing user-code
             # errors and enforcing feed_timeout (reference TFSparkNode.py:407-418).
             _join_with_error_check(mgr, queue, feed_timeout, "feeding")
@@ -454,6 +447,48 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
         return [count]
 
     return _train
+
+
+def _feed_blocks(iterator, put, chunk_size):
+    """Batch an item iterator into ``chunk_size`` blocks through ``put``;
+    returns the item count (shared by the train and inference feeders)."""
+    count = 0
+    block = []
+    for item in iterator:
+        block.append(item)
+        count += 1
+        if len(block) >= chunk_size:
+            put(block)
+            block = []
+    if block:
+        put(block)
+    return count
+
+
+def _chunk_putter(queue, cluster_meta, executor_id, qname, feed_timeout):
+    """Returns ``put(block)`` sending item blocks the fastest way available:
+    payload through the native shm ring with an ordering token on the queue,
+    or a plain in-queue Chunk when the ring is unavailable / the record is
+    oversized (see :mod:`~tensorflowonspark_tpu.shmring`)."""
+    import pickle
+
+    from tensorflowonspark_tpu import shmring
+
+    ring = None
+    if shmring.available():
+        ring = shmring.get_ring(
+            shmring.ring_name(cluster_meta["id"], executor_id, qname),
+            create=True)
+
+    def put(block):
+        if ring is not None:
+            data = pickle.dumps(block, protocol=pickle.HIGHEST_PROTOCOL)
+            if ring.put_bytes(data, timeout_secs=feed_timeout):
+                queue.put(marker.ShmChunk(ring.name, len(block)), block=True)
+                return
+        queue.put(marker.Chunk(block), block=True)
+
+    return put
 
 
 def _join_with_error_check(mgr, queue, timeout, phase):
@@ -506,16 +541,18 @@ def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
         mgr = _get_manager(cluster_info, host, executor_id)
         queue_in = mgr.get_queue(qname_in)
 
+        put = _chunk_putter(queue_in, cluster_meta, executor_id, qname_in,
+                            feed_timeout)
         count = 0
         block = []
         for item in iterator:
             block.append(item)
             count += 1
             if len(block) >= 256:
-                queue_in.put(marker.Chunk(block), block=True)
+                put(block)
                 block = []
         if block:
-            queue_in.put(marker.Chunk(block), block=True)
+            put(block)
         # Signal end-of-partition so DataFeed can align result batches
         # (reference TFSparkNode.py:469, marker.py).
         queue_in.put(marker.EndPartition(), block=True)
@@ -584,6 +621,16 @@ def shutdown(cluster_info, cluster_meta, queues=("input",), grace_secs=0):
             raise Exception("Exception in user code:\n{}".format(trace))
 
         mgr.set("state", "stopped")
+
+        # Remove this executor's shm-ring transports (payload fast path,
+        # shmring.py); mappings held by live processes stay valid.
+        from tensorflowonspark_tpu import shmring
+
+        if shmring.available():
+            for qn in queues:
+                shmring.unlink(
+                    shmring.ring_name(cluster_meta["id"], executor_id, qn))
+
         state_file = os.path.join(os.getcwd(), "cluster_state.json")
         if os.path.exists(state_file):
             with open(state_file, "w") as f:

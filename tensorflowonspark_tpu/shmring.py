@@ -1,0 +1,200 @@
+"""Python face of the native shared-memory feed transport (``native/shmring.cc``).
+
+Bulk chunk payloads move through a lock-free SPSC shared-memory ring between
+the feed task and the training process; the manager ``JoinableQueue`` keeps
+carrying one tiny ordering token per chunk
+(:class:`~tensorflowonspark_tpu.marker.ShmChunk`), so join/backpressure/
+fail-fast semantics are exactly the chunked-queue path's — only the payload
+bytes stop crossing the manager socket.  Falls back transparently (tokens
+are only sent when the ring accepted the payload; oversized or ring-less
+chunks travel in-queue as plain :class:`~tensorflowonspark_tpu.marker.Chunk`).
+
+The reference's counterpart was the manager proxy itself (reference
+``TFManager.py``, per-element hops, SURVEY §3.2); this is the TPU-era
+replacement for hosts that feed accelerators at GB/s.
+"""
+
+import ctypes
+import logging
+import os
+import pickle
+
+from tensorflowonspark_tpu import native
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CAPACITY = int(os.environ.get("TFOS_SHM_RING_MB", "64")) << 20
+
+_CLOSED = -2
+_TIMEOUT = -1
+
+
+def _lib():
+    lib = native.load("shmring")
+    if lib is None:
+        return None
+    if not getattr(lib, "_shmring_typed", False):
+        lib.shmring_create.restype = ctypes.c_void_p
+        lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shmring_attach.restype = ctypes.c_void_p
+        lib.shmring_attach.argtypes = [ctypes.c_char_p]
+        lib.shmring_write.restype = ctypes.c_int
+        lib.shmring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_uint64]
+        lib.shmring_next_len.restype = ctypes.c_int64
+        lib.shmring_next_len.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.shmring_pop.restype = ctypes.c_int64
+        lib.shmring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                    ctypes.c_uint64]
+        lib.shmring_fill.restype = ctypes.c_uint64
+        lib.shmring_fill.argtypes = [ctypes.c_void_p]
+        lib.shmring_close.argtypes = [ctypes.c_void_p]
+        lib.shmring_closed.restype = ctypes.c_int
+        lib.shmring_closed.argtypes = [ctypes.c_void_p]
+        lib.shmring_reopen.argtypes = [ctypes.c_void_p]
+        lib.shmring_free.argtypes = [ctypes.c_void_p]
+        lib.shmring_unlink.restype = ctypes.c_int
+        lib.shmring_unlink.argtypes = [ctypes.c_char_p]
+        lib._shmring_typed = True
+    return lib
+
+
+def available():
+    return not os.environ.get("TFOS_DISABLE_SHM") and _lib() is not None
+
+
+def ring_name(cluster_id, executor_id, qname):
+    """shm object name for one executor queue's transport (namespaced by the
+    per-run cluster id, so stale objects from crashed runs never collide)."""
+    return "/tfos_{}_{}_{}".format(cluster_id, executor_id, qname)
+
+
+class RingClosed(Exception):
+    pass
+
+
+class Ring(object):
+    """Handle over one shm ring; producer and consumer both use this class.
+
+    ``create_or_attach`` is what feeders/consumers call: the first process
+    creates, everyone else attaches (the C side's O_EXCL create makes the
+    race safe).
+    """
+
+    def __init__(self, handle, name):
+        self._h = handle
+        self.name = name
+
+    @classmethod
+    def create_or_attach(cls, name, capacity=DEFAULT_CAPACITY):
+        lib = _lib()
+        if lib is None:
+            return None
+        h = lib.shmring_create(name.encode(), capacity)
+        if not h:
+            h = lib.shmring_attach(name.encode())
+        if not h:
+            logger.warning("cannot create/attach shm ring %s", name)
+            return None
+        return cls(h, name)
+
+    @classmethod
+    def attach(cls, name):
+        lib = _lib()
+        if lib is None:
+            return None
+        h = lib.shmring_attach(name.encode())
+        if not h:
+            return None
+        return cls(h, name)
+
+    def put_bytes(self, data, timeout_secs=600):
+        """Write one record; returns True, or False if it can never fit
+        (caller falls back to the queue path).  Raises on timeout."""
+        rc = _lib().shmring_write(self._h, data, len(data),
+                                  int(timeout_secs * 1000))
+        if rc == 0:
+            return True
+        if rc == -3:
+            return False
+        if rc == _CLOSED:
+            raise RingClosed(self.name)
+        raise TimeoutError(
+            "shm ring {} write timed out after {}s (consumer stalled?)".format(
+                self.name, timeout_secs))
+
+    def get_bytes(self, timeout_secs=600):
+        """Read one record; raises RingClosed at end, TimeoutError on stall."""
+        lib = _lib()
+        n = lib.shmring_next_len(self._h, int(timeout_secs * 1000))
+        if n == _CLOSED:
+            raise RingClosed(self.name)
+        if n == _TIMEOUT:
+            raise TimeoutError(
+                "shm ring {} read timed out after {}s".format(
+                    self.name, timeout_secs))
+        buf = ctypes.create_string_buffer(int(n))
+        got = lib.shmring_pop(self._h, buf, int(n))
+        assert got == n, (got, n)
+        return buf.raw
+
+    def put(self, obj, timeout_secs=600):
+        """Pickle + write; returns False when the object can never fit."""
+        return self.put_bytes(
+            pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL), timeout_secs)
+
+    def get(self, timeout_secs=600):
+        return pickle.loads(self.get_bytes(timeout_secs))
+
+    def fill(self):
+        return _lib().shmring_fill(self._h)
+
+    def close_writes(self):
+        _lib().shmring_close(self._h)
+
+    def reopen(self):
+        _lib().shmring_reopen(self._h)
+
+    def detach(self, unlink=False):
+        """Release the mapping; ``unlink=True`` also removes the shm object
+        (call once, at cluster shutdown)."""
+        if self._h:
+            if unlink:
+                _lib().shmring_unlink(self.name.encode())
+            _lib().shmring_free(self._h)
+            self._h = None
+
+
+def unlink(name):
+    """Remove the shm object (idempotent; live mappings stay valid)."""
+    lib = _lib()
+    if lib is not None:
+        lib.shmring_unlink(name.encode())
+    _rings.pop(name, None)
+
+
+_rings = {}    # per-process handle cache: rings live for the process lifetime
+_created = set()  # names this process created: unlinked at exit as a safety
+                  # net for runs that die before the shutdown job unlinks
+
+
+def _atexit_unlink():
+    for name in list(_created):
+        unlink(name)
+
+
+def get_ring(name, create=False):
+    """Process-cached create-or-attach (handles must not churn per task —
+    see shmring_free's contract in native/shmring.cc)."""
+    ring = _rings.get(name)
+    if ring is None:
+        ring = (Ring.create_or_attach(name) if create else Ring.attach(name))
+        if ring is not None:
+            _rings[name] = ring
+            if create:
+                if not _created:
+                    import atexit
+
+                    atexit.register(_atexit_unlink)
+                _created.add(name)
+    return ring

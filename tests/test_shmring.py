@@ -1,0 +1,105 @@
+"""Native shared-memory ring tests (feed data plane, ``native/shmring.cc``)."""
+
+import multiprocessing
+import os
+import uuid
+
+import pytest
+
+from tensorflowonspark_tpu import shmring
+
+pytestmark = pytest.mark.skipif(
+    not shmring.available(), reason="native shmring unavailable")
+
+
+@pytest.fixture
+def ring():
+    name = "/tfos_test_{}".format(uuid.uuid4().hex[:12])
+    r = shmring.Ring.create_or_attach(name, capacity=1 << 20)
+    assert r is not None
+    yield r
+    r.detach(unlink=True)
+
+
+def test_roundtrip_bytes(ring):
+    ring.put_bytes(b"hello")
+    ring.put_bytes(b"" )
+    ring.put_bytes(b"x" * 100000)
+    assert ring.get_bytes() == b"hello"
+    assert ring.get_bytes() == b""
+    assert ring.get_bytes() == b"x" * 100000
+
+
+def test_pickle_objects(ring):
+    ring.put({"a": [1, 2, 3], "b": "text"})
+    assert ring.get() == {"a": [1, 2, 3], "b": "text"}
+
+
+def test_wraparound_many_records(ring):
+    # total volume >> capacity forces many wraps; interleave put/get
+    payloads = [os.urandom((i * 7919) % 40000 + 1) for i in range(200)]
+    got = []
+    it = iter(payloads)
+    pending = 0
+    sent = 0
+    for p in payloads:
+        ring.put_bytes(p)
+        sent += 1
+        pending += 1
+        if pending >= 8:  # drain in bursts so the ring must wrap
+            for _ in range(pending):
+                got.append(ring.get_bytes())
+            pending = 0
+    for _ in range(pending):
+        got.append(ring.get_bytes())
+    assert got == payloads
+
+
+def test_oversized_record_returns_false(ring):
+    assert ring.put_bytes(b"y" * (2 << 20)) is False  # > capacity
+
+
+def test_close_semantics(ring):
+    ring.put_bytes(b"last")
+    ring.close_writes()
+    assert ring.get_bytes() == b"last"  # drains before raising
+    with pytest.raises(shmring.RingClosed):
+        ring.get_bytes(timeout_secs=1)
+    ring.reopen()
+    ring.put_bytes(b"again")
+    assert ring.get_bytes() == b"again"
+
+
+def test_read_timeout(ring):
+    with pytest.raises(TimeoutError):
+        ring.get_bytes(timeout_secs=0.2)
+
+
+def _producer(name, n, chunk):
+    r = shmring.Ring.attach(name)
+    for i in range(n):
+        r.put_bytes(bytes([i % 256]) * chunk)
+    r.close_writes()
+    r.detach()
+
+
+def test_cross_process_throughput(ring):
+    # real two-process SPSC: producer in a child, consumer here
+    n, chunk = 500, 32768
+    proc = multiprocessing.get_context("spawn").Process(
+        target=_producer, args=(ring.name, n, chunk))
+    proc.start()
+    got = 0
+    try:
+        while True:
+            try:
+                data = ring.get_bytes(timeout_secs=30)
+            except shmring.RingClosed:
+                break
+            assert len(data) == chunk
+            assert data[0] == got % 256
+            got += 1
+    finally:
+        proc.join(30)
+    assert got == n
+    assert proc.exitcode == 0

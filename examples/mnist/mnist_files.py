@@ -97,7 +97,7 @@ def main_fun(args, ctx):
         if args.max_steps and step_count >= args.max_steps:
             break
 
-    trainer.history.on_train_end()
+    trainer.history.on_train_end(loss)
     stats = trainer.history.log_stats(loss=float(loss))
     if ckpt:
         ckpt.maybe_save(step_count, jax.device_get(trainer.state), force=True)

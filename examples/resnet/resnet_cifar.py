@@ -137,7 +137,7 @@ def main_fun(args, ctx):
 
     if prof:
         prof.stop()
-    trainer.history.on_train_end()
+    trainer.history.on_train_end(loss)
     stats = trainer.history.log_stats(
         loss=float(loss), accuracy=float(aux["accuracy"]))
     if ckpt:

@@ -90,7 +90,7 @@ def main_fun(args, ctx):
             if step >= args.train_steps:
                 break
 
-    trainer.history.on_train_end()
+    trainer.history.on_train_end(loss)
     stats = trainer.history.log_stats(
         loss=float(loss), accuracy=float(aux["accuracy"]))
     if args.export_dir and checkpoint.should_export(ctx):

@@ -92,9 +92,9 @@ def main_fun(args, ctx):
         for _ in range(args.train_steps):
             tokens, mask = next_batch()
             params, opt_state, l = step_fn(params, opt_state, tokens, mask)
-            history.on_step_end()
+            history.on_step_end(l)
     lval = float(l)
-    history.on_train_end()
+    history.on_train_end(l)
     stats = history.log_stats(loss=lval)
 
     if args.export_dir and checkpoint.should_export(ctx):

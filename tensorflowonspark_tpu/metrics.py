@@ -13,12 +13,19 @@ import time
 
 logger = logging.getLogger(__name__)
 
-# Peak dense (bf16) FLOPs per chip for MFU accounting.
+# Peak dense (bf16) FLOPs per chip for MFU accounting, keyed on the FULL
+# lowercased ``device_kind`` string (exact match, not prefix: "tpu v5"
+# must never swallow "tpu v5 lite" — a silent 2.3x MFU error).
 PEAK_FLOPS = {
-    "tpu v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16 (394 is the int8 figure)
-    "tpu v5": 459e12,        # v5p
+    "tpu v2": 46e12,
+    "tpu v3": 123e12,
     "tpu v4": 275e12,
+    "tpu v5 lite": 197e12,   # v5e: 197 TFLOP/s bf16 (394 is the int8 figure)
+    "tpu v5e": 197e12,
+    "tpu v5": 459e12,        # v5p reports plain "TPU v5" on some stacks
+    "tpu v5p": 459e12,
     "tpu v6 lite": 918e12,   # v6e / trillium
+    "tpu v6e": 918e12,
     "cpu": 1e11,             # nominal figure so tests exercise the math
 }
 
@@ -27,11 +34,11 @@ def peak_flops_per_device():
     import jax
 
     kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
-    for key, val in PEAK_FLOPS.items():
-        if kind.startswith(key):
-            return val
-    logger.warning("unknown device kind %r; MFU will be reported as 0", kind)
-    return None
+    val = PEAK_FLOPS.get(kind)
+    if val is None:
+        logger.warning(
+            "unknown device kind %r; MFU will be reported as None", kind)
+    return val
 
 
 def estimate_step_flops(jitted_fn, *args, **kwargs):
@@ -55,10 +62,18 @@ def estimate_step_flops(jitted_fn, *args, **kwargs):
 class TimeHistory(object):
     """Per-N-step timing + throughput recorder (reference ``common.py:177``).
 
-    Call :meth:`on_step_end` once per global step.  Timestamps of each
+    Call :meth:`on_step_end(value)` once per global step, passing a device
+    value data-dependent on that step (the loss).  Timestamps of each
     N-step window land in ``timestamp_log`` exactly like the reference's
     Keras callback, so ``avg_examples_per_second`` is computed the same way
     (reference ``common.py:236-244``).
+
+    Timing discipline: jax dispatch is asynchronous — the host returns from
+    a jitted call long before the device finishes, so timestamping the host
+    clock alone measures dispatch rate, not step time (it reported >100%
+    MFU).  At every window boundary we therefore force a device->host
+    readback of ``value`` before reading the clock; steps *within* a window
+    still pipeline freely, so the sync cost amortizes over ``log_steps``.
     """
 
     def __init__(self, batch_size, log_steps=20, step_flops=None,
@@ -80,11 +95,23 @@ class TimeHistory(object):
         self.start_time = time.time()
         self.timestamp_log.append((0, self.start_time))
 
-    def on_step_end(self):
+    @staticmethod
+    def _sync(value):
+        """Force a device->host readback so the host clock reflects device
+        completion.  A readback (not just ``block_until_ready``): on
+        remotely-attached backends the transfer is the only barrier that
+        provably spans the full dispatch chain."""
+        if value is not None:
+            import jax
+
+            jax.device_get(jax.block_until_ready(value))
+
+    def on_step_end(self, value=None):
         if self.train_start_time is None:
             self.on_train_begin()
         self.global_steps += 1
         if self.global_steps % self.log_steps == 0:
+            self._sync(value)
             now = time.time()
             elapsed = now - self.start_time
             eps = self.batch_size * self.log_steps / elapsed
@@ -99,7 +126,8 @@ class TimeHistory(object):
             self.timestamp_log.append((self.global_steps, now))
             self.start_time = now
 
-    def on_train_end(self):
+    def on_train_end(self, value=None):
+        self._sync(value)
         self.elapsed = time.time() - self.train_start_time
 
     def mfu(self, step_seconds):

@@ -132,7 +132,10 @@ class Trainer(object):
             self.compile_and_measure(batch, mask)
             self.history.on_train_begin()
         self.state, loss, aux = self._train_step(self.state, batch, mask)
-        self.history.on_step_end()
+        # Passing the loss lets TimeHistory sync on device completion at
+        # window boundaries (honest ms/step + MFU under async dispatch);
+        # within a window steps still pipeline.
+        self.history.on_step_end(loss)
         return loss, aux
 
     def fit_feed(self, sharded_feed, max_steps=None):
@@ -156,7 +159,7 @@ class Trainer(object):
                     sharded_feed.terminate()
                 break
         if self.history:
-            self.history.on_train_end()
+            self.history.on_train_end(last_loss)
             return self.history.log_stats(
                 loss=None if last_loss is None else float(last_loss))
         return {}

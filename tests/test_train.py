@@ -80,6 +80,37 @@ class TestMetrics:
         flops = metrics_mod.estimate_step_flops(f, x, x)
         assert flops and flops >= 2 * 64 * 64 * 64 * 0.9
 
+    def test_peak_flops_exact_match_no_prefix_swallow(self):
+        # "tpu v5" must not swallow "tpu v5 lite"/"tpu v5p" (2.3x MFU error)
+        assert metrics_mod.PEAK_FLOPS["tpu v5 lite"] == 197e12
+        assert metrics_mod.PEAK_FLOPS["tpu v5e"] == 197e12
+        assert metrics_mod.PEAK_FLOPS["tpu v5p"] == 459e12
+        assert metrics_mod.PEAK_FLOPS["tpu v5"] == 459e12
+        # lookup is exact-match on the full device_kind string
+        assert "tpu v4" in metrics_mod.PEAK_FLOPS
+        assert metrics_mod.PEAK_FLOPS.get("tpu v5 lite x") is None
+
+    def test_mfu_physically_possible_on_real_trainer(self):
+        # Regression for >100%-MFU: window timing must sync on device
+        # completion, so MFU from a real trainer run is always <= 1.
+        mesh = build_mesh()
+        params = {"w": jnp.zeros((2,)), "b": jnp.zeros(())}
+        tr = Trainer(_linear_loss, params, optax.adam(0.1), mesh=mesh,
+                     batch_size=64, log_steps=5)
+        loss = None
+        for step in range(20):
+            loss, _ = tr.step(_make_batch(mesh, seed=step))
+        tr.history.on_train_end(loss)
+        stats = tr.history.build_stats(loss=float(loss))
+        if "mfu" in stats:
+            assert 0.0 < stats["mfu"] <= 1.0, stats
+        # per-window MFU too: recompute from the timestamp log
+        log = tr.history.timestamp_log
+        for (s0, t0), (s1, t1) in zip(log, log[1:]):
+            mfu = tr.history.mfu((t1 - t0) / (s1 - s0))
+            if mfu is not None:
+                assert mfu <= 1.0, (s0, s1, mfu)
+
 
 class TestCheckpoint:
     def test_save_restore_roundtrip(self, tmp_path):

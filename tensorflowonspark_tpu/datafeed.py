@@ -12,12 +12,15 @@ into a sharded global batch (see :mod:`tensorflowonspark_tpu.parallel.infeed`).
 
 import logging
 import queue as _queue
+import threading
 
 import numpy as np
 
 from tensorflowonspark_tpu import marker
 
 logger = logging.getLogger(__name__)
+
+_INTERRUPTED = object()  # internal next_batch abort marker (see interrupt())
 
 
 def absolute_path(ctx, path):
@@ -92,6 +95,10 @@ class DataFeed(object):
         self._buffer = []
         self._buffer_idx = 0
         self._chunk_q = None
+        # Set by interrupt(): unblocks a next_batch blocked on the queue so
+        # another thread can take over queue consumption (the queue/ring is
+        # single-consumer; see ShardedFeed.terminate).
+        self._interrupt = threading.Event()
 
     def next_batch(self, batch_size):
         """Get up to ``batch_size`` items from the input queue.
@@ -114,7 +121,10 @@ class DataFeed(object):
                 self._buffer_idx += 1
                 from_queue = False
             else:
-                item = queue.get(block=True)
+                item = self._get_interruptible(queue)
+                if item is _INTERRUPTED:
+                    logger.info("next_batch: interrupted with %d items", count)
+                    break
                 from_queue = True
                 if isinstance(item, marker.ShmChunk):
                     # Payload took the native shm-ring fast path; the token
@@ -163,6 +173,25 @@ class DataFeed(object):
                     self._ack_chunk()
         logger.debug("next_batch: returning %d items", count)
         return tensors
+
+    def _get_interruptible(self, queue):
+        """Blocking get that aborts (returning ``_INTERRUPTED``) once
+        :meth:`interrupt` fires.  Short-timeout polling, not ``block=True``:
+        the proxy's blocking get cannot be cancelled from another thread."""
+        while not self._interrupt.is_set():
+            try:
+                return queue.get(block=True, timeout=0.5)
+            except _queue.Empty:
+                continue
+        return _INTERRUPTED
+
+    def interrupt(self):
+        """Unblock a concurrent :meth:`next_batch` and make subsequent calls
+        return immediately.  Used to hand queue ownership from a consumer
+        thread to :meth:`terminate`'s drain — the queue and shm ring are
+        strictly single-consumer, so the old consumer must be out before the
+        drain starts."""
+        self._interrupt.set()
 
     def _ack_chunk(self):
         if self._chunk_q is not None:

@@ -46,10 +46,23 @@ def run_inference(export_dir, rows, input_mapping=None, output_name="prediction"
     if input_mapping:
         (in_col, tensor_name), = input_mapping.items()  # single-input models
     else:
-        in_col = next(iter(signature)) if signature else None
+        in_col = tensor_name = next(iter(signature)) if signature else None
 
-    shape = signature.get(in_col) if in_col in (signature or {}) else (
-        next(iter(signature.values())) if signature else None)
+    # The export's input_signature is keyed by TENSOR name (checkpoint.
+    # export_model), so the lookup must use the mapping's tensor name, not
+    # the DataFrame column name — they differ whenever input_mapping
+    # renames.  Falling back to "the first entry" is only safe when the
+    # signature has exactly one input.
+    shape = None
+    if signature:
+        shape = signature.get(tensor_name)
+        if shape is None:
+            if len(signature) > 1:
+                raise ValueError(
+                    "tensor {!r} (from input_mapping) not found in the "
+                    "export's multi-input signature {}; cannot guess which "
+                    "input it feeds".format(tensor_name, sorted(signature)))
+            shape = next(iter(signature.values()))
 
     for lo in range(0, len(rows), batch_size):
         chunk = rows[lo:lo + batch_size]

@@ -253,6 +253,25 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         with open(state_file, "w") as f:
             json.dump({"cluster_id": cluster_meta["id"], "state": "running"}, f)
 
+        # Pre-create the shm-ring feed transports HERE, in the long-lived
+        # node process, so the creator's lifetime matches the consumer's.
+        # Feed tasks only attach: if a short-lived (non-reused) feed worker
+        # created a ring, its exit would unlink it under the consumer and
+        # the next feed task would create a second ring with the same name
+        # — tokens then promise records that never arrive (the hazard
+        # native/shmring.cc's shmring_free contract documents).
+        from tensorflowonspark_tpu import shmring
+
+        if shmring.available():
+            # Only feed-direction queues get a ring: results travel back as
+            # plain Chunks (DataFeed.batch_results), and error/control carry
+            # single small messages.
+            for qn in qnames:
+                if qn not in ("error", "control", "output"):
+                    shmring.get_ring(
+                        shmring.ring_name(cluster_meta["id"], executor_id, qn),
+                        create=True)
+
         # TensorBoard on the first worker-like node (reference TFSparkNode.py:199-225).
         tb_pid, tb_port = 0, 0
         if tensorboard and job_name in ("chief", "master", "worker") and task_index == 0:
@@ -474,11 +493,14 @@ def _chunk_putter(queue, cluster_meta, executor_id, qname, feed_timeout):
 
     from tensorflowonspark_tpu import shmring
 
+    # Attach-only: the node process created the ring at startup (run());
+    # a feed task must never create one, or a recycled Spark worker's exit
+    # would unlink it under the live consumer (see run()).  No ring (e.g. a
+    # custom qname the node didn't pre-create) falls back to plain Chunks.
     ring = None
     if shmring.available():
         ring = shmring.get_ring(
-            shmring.ring_name(cluster_meta["id"], executor_id, qname),
-            create=True)
+            shmring.ring_name(cluster_meta["id"], executor_id, qname))
 
     def put(block):
         if ring is not None:
@@ -531,7 +553,7 @@ def _join_with_error_check(mgr, queue, timeout, phase):
 
 
 def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
-              feed_timeout=600):
+              feed_timeout=600, chunk_size=256):
     """Inference feed-job closure: push one partition, await exactly one result
     per input item (reference ``TFSparkNode.py:441-502``)."""
 
@@ -543,16 +565,7 @@ def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
 
         put = _chunk_putter(queue_in, cluster_meta, executor_id, qname_in,
                             feed_timeout)
-        count = 0
-        block = []
-        for item in iterator:
-            block.append(item)
-            count += 1
-            if len(block) >= 256:
-                put(block)
-                block = []
-        if block:
-            put(block)
+        count = _feed_blocks(iterator, put, chunk_size)
         # Signal end-of-partition so DataFeed can align result batches
         # (reference TFSparkNode.py:469, marker.py).
         queue_in.put(marker.EndPartition(), block=True)

@@ -56,6 +56,8 @@ class ShardedFeed(object):
         self._prefetch_depth = prefetch
         self._sharding = mesh_mod.batch_sharding(mesh)
         self._num_processes = jax.process_count()
+        self._stop = None            # prefetch stop event (set in batches())
+        self._prefetch_thread = None
 
     # -- host-side batch assembly ----------------------------------------
 
@@ -113,7 +115,7 @@ class ShardedFeed(object):
         program); the per-step consensus guarantees they agree on when to
         stop, even when Spark partitions are uneven across hosts.
         """
-        stop = threading.Event()
+        stop = self._stop = threading.Event()
         source = (self._prefetched_locals(stop) if self._prefetch_depth
                   else self._local_iter())
         try:
@@ -134,7 +136,20 @@ class ShardedFeed(object):
     def terminate(self):
         """Terminate feeding early (training hit max steps with data left):
         marks the node terminating and drains the input queue so blocked
-        feeders unblock (reference ``TFNode.terminate``, ``TFNode.py:172-194``)."""
+        feeders unblock (reference ``TFNode.terminate``, ``TFNode.py:172-194``).
+
+        The queue and shm ring are strictly single-consumer, so the prefetch
+        thread must be fully out before the drain starts: concurrent get/
+        task_done from two threads can double-ack (spurious ValueError after
+        successful training) or desync the ring tail.  Stop the producer,
+        interrupt its blocked get, join it — then drain.
+        """
+        if self._stop is not None:
+            self._stop.set()
+        t = self._prefetch_thread
+        if t is not None and t.is_alive():
+            self.feed.interrupt()
+            t.join()
         self.feed.terminate()
 
     def _local_iter(self):
@@ -179,6 +194,7 @@ class ShardedFeed(object):
 
         t = threading.Thread(target=_producer, name="infeed-prefetch",
                              daemon=True)
+        self._prefetch_thread = t
         t.start()
         while True:
             item = buf.get()

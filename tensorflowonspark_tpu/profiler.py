@@ -70,9 +70,11 @@ class StepProfiler(object):
     """Capture a device trace over a global-step range.
 
     Usage: ``prof = StepProfiler(log_dir, "10,20")`` then call
-    ``prof.on_step_end()`` after every step (mirrors
-    :class:`~tensorflowonspark_tpu.metrics.TimeHistory`); the trace starts
-    before step ``start`` executes and stops after step ``stop``.
+    ``prof.on_step_begin()`` before and ``prof.on_step_end()`` after every
+    step; the trace starts before step ``start`` executes and stops after
+    step ``stop``.  Callers that only hook ``on_step_end`` still get a
+    trace (it starts lazily, one step late — after step ``start``
+    completes) as long as the range spans more than one step.
     """
 
     def __init__(self, log_dir, profile_steps):
@@ -81,19 +83,28 @@ class StepProfiler(object):
         self.step = 0
         self._active = False
 
+    def _start(self):
+        import jax
+
+        jax.profiler.start_trace(self.log_dir)
+        self._active = True
+        logger.info("profiler trace started at step %d -> %s",
+                    self.step, self.log_dir)
+
     def on_step_begin(self):
         if self.bounds and not self._active and self.step == self.bounds[0]:
-            import jax
-
-            jax.profiler.start_trace(self.log_dir)
-            self._active = True
-            logger.info("profiler trace started at step %d -> %s",
-                        self.step, self.log_dir)
+            self._start()
 
     def on_step_end(self):
         self.step += 1
+        if not self.bounds:
+            return
         if self._active and self.step > self.bounds[1]:
             self.stop()
+        elif (not self._active
+              and self.bounds[0] <= self.step <= self.bounds[1]):
+            # on_step_begin was never called: start late rather than never.
+            self._start()
 
     def stop(self):
         if self._active:

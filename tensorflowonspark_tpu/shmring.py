@@ -175,7 +175,11 @@ def unlink(name):
 
 _rings = {}    # per-process handle cache: rings live for the process lifetime
 _created = set()  # names this process created: unlinked at exit as a safety
-                  # net for runs that die before the shutdown job unlinks
+                  # net for runs that die before the shutdown job unlinks.
+                  # Only the long-lived node process creates rings
+                  # (node.run pre-creates; feed tasks attach), so this
+                  # atexit can never unlink under a consumer that outlives
+                  # the creator.
 
 
 def _atexit_unlink():

@@ -92,6 +92,41 @@ def test_early_exit_stops_prefetch_consumption(mgr):
     assert remaining >= 64 - 8 - 3 * 8
 
 
+def test_terminate_joins_prefetch_before_drain(mgr):
+    """Regression (advisor r1): terminate() while the prefetch thread is
+    live must stop + join it BEFORE draining the queue — two concurrent
+    consumers can double-task_done (ValueError) or desync the shm ring."""
+    _fill(mgr, [[float(i)] for i in range(64)], end=False)
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=2)
+    gen = sf.batches()
+    next(gen)
+    sf.terminate()           # prefetch thread still running — must be joined
+    t = sf._prefetch_thread
+    assert t is not None and not t.is_alive()
+    gen.close()
+    assert mgr.get("state") == "terminating"
+
+
+def test_terminate_with_prefetch_blocked_on_empty_queue(mgr):
+    """terminate() when the prefetch thread is parked in a blocking get
+    (no more data, no sentinel yet) must interrupt it, not hang the join."""
+    _fill(mgr, [[float(i)] for i in range(8)], end=False)  # exactly one batch
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=2)
+    gen = sf.batches()
+    next(gen)                # prefetch now blocks on the empty queue
+    import time
+
+    time.sleep(0.3)
+    t0 = time.time()
+    sf.terminate()
+    assert time.time() - t0 < 10
+    t = sf._prefetch_thread
+    assert t is not None and not t.is_alive()
+    gen.close()
+
+
 def test_trainer_fit_feed_end_to_end(mgr):
     """fit_feed over a ShardedFeed with a partial tail trains and returns stats."""
     rng = np.random.RandomState(0)

@@ -1,81 +1,202 @@
-"""Headline benchmark: MNIST CNN training images/sec/chip.
+"""Headline benchmark: the BASELINE workloads END-TO-END through the framework.
 
-Runs the framework's batteries-included training path (Trainer: donated
-state, bf16 compute, jit train step) on the BASELINE.md headline workload —
-the reference's example MNIST CNN (reference
-``examples/mnist/keras/mnist_spark.py:14-20``) — and prints ONE JSON line:
+Two measurements (BASELINE.md targets table):
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+1. **MNIST images/sec/chip, end-to-end** — the reference's headline workload
+   (reference ``examples/mnist/keras/mnist_spark.py``) through the FULL
+   spark-submit-equivalent path: ``cluster.run(InputMode.SPARK)`` cluster
+   bootstrap, feed jobs pushing rows through the chunked/shm-ring data plane,
+   ``DataFeed -> ShardedFeed`` columnar assembly, ``Trainer.fit_feed`` on
+   device.  Throughput and MFU are reported by the in-run ``TimeHistory``
+   (which syncs on device completion at window boundaries).
 
-``vs_baseline`` is the measured throughput against the per-element feeding
-throughput ceiling of the reference's InputMode.SPARK data path on this
-host (the reference moves every example through a multiprocessing-manager
-proxy hop, reference ``TFNode.py:105-151``; we measure that hop's rate and
-it bounds the reference's achievable images/sec regardless of accelerator).
-The reference itself publishes no numbers (BASELINE.md).
+2. **ResNet-50 step time** — the reference's second headline (reference
+   ``examples/resnet/resnet_imagenet_main.py:271-285``) with synthetic
+   ImageNet-shaped data (the reference's own benchmark mode, reference
+   ``common.py:315-363``, reuses one synthetic batch), run inside the same
+   cluster lifecycle (FILES mode).
+
+Prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+``vs_baseline`` compares the measured end-to-end MNIST throughput against the
+per-element feeding ceiling of the reference's InputMode.SPARK data path on
+this host (the reference moves every example through a multiprocessing-manager
+proxy hop, reference ``TFNode.py:105-151``; that rate bounds the reference's
+achievable images/sec regardless of accelerator).  The reference itself
+publishes no numbers (BASELINE.md).
+
+The driver process never imports jax: the single executor's node process
+(and its forked training child) must be the only TPU client.
 """
 
+import argparse
 import json
+import os
+import tempfile
 import time
 
 import numpy as np
 
+MNIST_ROWS = 60000          # reference MNIST train-set size
+MNIST_BATCH = 1024
+MNIST_EPOCHS = 2
+RESNET_BATCH = 256
+RESNET_STEPS = 60
 
-def measure_train_throughput(batch_size=2048, steps=400, warmup=8):
+
+def mnist_main(args, ctx):
+    """Runs on the executor: MNIST CNN fed from the cluster data plane."""
     import jax
     import jax.numpy as jnp
     import optax
 
     from tensorflowonspark_tpu import train as train_mod
     from tensorflowonspark_tpu.models import mnist as mnist_mod
-    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel import infeed, mesh as mesh_mod
+
+    ctx.initialize_distributed()
+    mesh = mesh_mod.build_mesh()
 
     model = mnist_mod.build_mnist(dtype="bfloat16")
-    rng = np.random.default_rng(0)
-    images = rng.random((batch_size, 28, 28, 1), np.float32)
-    labels = rng.integers(0, 10, (batch_size,), np.int64)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 28, 28, 1)))["params"]
-
-    mesh = mesh_mod.build_mesh()
     trainer = train_mod.Trainer(
         mnist_mod.loss_fn(model), params,
         optax.sgd(0.01, momentum=0.9), mesh=mesh,
-        compute_dtype=jnp.bfloat16, batch_size=batch_size)
+        compute_dtype=jnp.bfloat16, batch_size=args.batch_size,
+        log_steps=20)
 
+    def preprocess(items):
+        images = np.stack([r[0] for r in items]).astype(np.float32)
+        labels = np.asarray([r[1] for r in items], np.int32)
+        return {"image": images.reshape(-1, 28, 28, 1), "label": labels}
+
+    # Warm up / compile on a synthetic batch of the same shapes, then reset
+    # the recorder so reported numbers are steady-state end-to-end.
+    warm = {"image": jnp.zeros((args.batch_size, 28, 28, 1), jnp.float32),
+            "label": jnp.zeros((args.batch_size,), jnp.int32)}
+    for _ in range(3):
+        trainer.step(warm)
+    trainer.reset_history()
+
+    feed = ctx.get_data_feed(train_mode=True)
+    sharded = infeed.ShardedFeed(feed, mesh, args.batch_size,
+                                 preprocess=preprocess)
+    # max_steps makes the run end deterministically once the step budget is
+    # consumed (without it a SPARK-mode worker only stops when shutdown's
+    # poison pill arrives, so the driver could never wait for the stats
+    # before shutting down).
+    stats = trainer.fit_feed(sharded, max_steps=args.max_steps)
+    stats["n_devices"] = len(jax.devices())
+    stats["device_kind"] = jax.devices()[0].device_kind
+    if ctx.is_chief():
+        with open(args.stats_path, "w") as f:
+            json.dump(stats, f, default=float)
+    return stats
+
+
+def resnet_main(args, ctx):
+    """Runs on the executor: ResNet-50 v1.5, synthetic ImageNet batch
+    (reference benchmark mode, ``common.py:315-363``)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import resnet as resnet_mod
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    ctx.initialize_distributed()
+    mesh = mesh_mod.build_mesh()
     sharding = mesh_mod.batch_sharding(mesh)
+
+    model = resnet_mod.build_resnet50(dtype="bfloat16")
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3)))
+    trainer = train_mod.Trainer(
+        resnet_mod.loss_fn(model, weight_decay=1e-4),
+        variables["params"],
+        optax.sgd(0.1, momentum=0.9),
+        extra_state=variables["batch_stats"],
+        mesh=mesh, compute_dtype=jnp.bfloat16,
+        batch_size=args.batch_size, log_steps=20)
+
+    rng = np.random.default_rng(0)
     batch = {
-        "image": jax.device_put(images, sharding),
-        "label": jax.device_put(labels, sharding),
+        "image": jax.device_put(
+            rng.random((args.batch_size, 224, 224, 3), np.float32), sharding),
+        "label": jax.device_put(
+            rng.integers(0, 1000, (args.batch_size,)), sharding),
     }
-    mask = jax.device_put(np.ones((batch_size,), np.float32), sharding)
+    for _ in range(5):
+        loss, _ = trainer.step(batch)
+    trainer.reset_history()
+    for _ in range(args.steps):
+        loss, _ = trainer.step(batch)
+    trainer.history.on_train_end(loss)
+    stats = trainer.history.build_stats(loss=float(loss))
+    stats["n_devices"] = len(jax.devices())
+    if ctx.is_chief():
+        with open(args.stats_path, "w") as f:
+            json.dump(stats, f, default=float)
+    return stats
 
-    # Timing discipline: on remotely-attached (tunneled) TPU backends,
-    # ``block_until_ready`` can return before device execution completes, so
-    # the only trustworthy completion barrier is a device->host readback of a
-    # value data-dependent on the whole step chain (the last step's loss).
-    # Measure the readback round trip separately and subtract it.
-    loss = None
-    for _ in range(max(warmup, 1)):
-        loss, _ = trainer.step(batch, mask)
-    float(loss)  # full sync
-    # Bare round-trip probe: state.step is already computed on device but its
-    # host value has never been fetched (float(loss) caches only loss), so
-    # this times a real device->host transfer, not a cached read.
-    t0 = time.time()
-    float(trainer.state.step)
-    rtt = time.time() - t0
 
-    t0 = time.time()
-    for _ in range(steps):
-        loss, _ = trainer.step(batch, mask)
-    float(loss)  # completion barrier: depends on every step above
-    elapsed = max(time.time() - t0 - rtt, 1e-9)
+def _run_cluster(main_fun, args, input_mode, feed_partitions=None,
+                 num_epochs=1, stats_timeout=600):
+    """Drive one single-executor cluster end-to-end; returns the stats the
+    chief wrote."""
+    from tensorflowonspark_tpu import backend, cluster
 
-    n_dev = len(jax.devices())
-    ips_per_chip = batch_size * steps / elapsed / n_dev
-    mfu = trainer.history.mfu(elapsed / steps)
-    return ips_per_chip, float(loss), mfu, n_dev
+    b = backend.LocalBackend(1)
+    try:
+        c = cluster.run(b, main_fun, args, num_executors=1,
+                        input_mode=input_mode)
+        if feed_partitions is not None:
+            c.train(feed_partitions, num_epochs=num_epochs)
+            # The worker finishes (and writes its stats) shortly after its
+            # max_steps budget; wait for that before poisoning the queues.
+            deadline = time.time() + stats_timeout
+            while not os.path.exists(args.stats_path):
+                if time.time() > deadline:
+                    raise TimeoutError("worker stats never appeared at "
+                                       + args.stats_path)
+                time.sleep(0.5)
+        c.shutdown(grace_secs=2)
+    finally:
+        b.stop()
+    with open(args.stats_path) as f:
+        return json.load(f)
+
+
+def measure_mnist_e2e(rows=MNIST_ROWS, batch_size=MNIST_BATCH,
+                      epochs=MNIST_EPOCHS):
+    from tensorflowonspark_tpu import backend, cluster
+
+    rng = np.random.default_rng(0)
+    images = (rng.random((rows, 784)) * 255).astype(np.float32)
+    labels = rng.integers(0, 10, (rows,), np.int64)
+    data = [(images[i], int(labels[i])) for i in range(rows)]
+
+    args = argparse.Namespace(
+        batch_size=batch_size,
+        max_steps=(rows * epochs) // batch_size,
+        stats_path=os.path.join(tempfile.mkdtemp(), "mnist_stats.json"))
+    stats = _run_cluster(
+        mnist_main, args, cluster.InputMode.SPARK,
+        feed_partitions=backend.partition(data, 8), num_epochs=epochs)
+    return stats
+
+
+def measure_resnet50(batch_size=RESNET_BATCH, steps=RESNET_STEPS):
+    from tensorflowonspark_tpu import cluster
+
+    args = argparse.Namespace(
+        batch_size=batch_size, steps=steps,
+        stats_path=os.path.join(tempfile.mkdtemp(), "resnet_stats.json"))
+    return _run_cluster(resnet_main, args, cluster.InputMode.FILES)
 
 
 def measure_reference_feed_ceiling(n_items=60000):
@@ -106,18 +227,36 @@ def measure_reference_feed_ceiling(n_items=60000):
 
 
 def main():
-    ips_per_chip, loss, mfu, n_dev = measure_train_throughput()
+    mnist = measure_mnist_e2e()
+    try:
+        resnet = measure_resnet50()
+    except (Exception, SystemExit) as e:  # secondary metric: never sink the
+        resnet = {"error": str(e)}        # headline (shutdown exits 1 on a
+                                          # node failure — catch that too)
     try:
         ceiling = measure_reference_feed_ceiling()
     except Exception:
         ceiling = None
-    vs = (ips_per_chip / ceiling) if ceiling else 1.0
-    print(json.dumps({
-        "metric": "mnist_train_images_per_sec_per_chip",
+
+    n_dev = max(int(mnist.get("n_devices", 1)), 1)
+    ips_per_chip = mnist["avg_exp_per_second"] / n_dev
+    out = {
+        "metric": "mnist_e2e_train_images_per_sec_per_chip",
         "value": round(ips_per_chip, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(vs, 2),
-    }))
+        "vs_baseline": round(ips_per_chip / ceiling, 2) if ceiling else 1.0,
+        "mnist_mfu": round(mnist["mfu"], 4) if "mfu" in mnist else None,
+        "mnist_ms_per_step": round(1000 * mnist["avg_step_seconds"], 3)
+        if "avg_step_seconds" in mnist else None,
+        "resnet50_step_time_ms": round(1000 * resnet["avg_step_seconds"], 2)
+        if "avg_step_seconds" in resnet else None,
+        "resnet50_mfu": round(resnet["mfu"], 4) if "mfu" in resnet else None,
+        "resnet50_images_per_sec_per_chip": round(
+            resnet["avg_exp_per_second"] / max(int(resnet.get("n_devices", 1)), 1), 1)
+        if "avg_exp_per_second" in resnet else None,
+        "device_kind": mnist.get("device_kind"),
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

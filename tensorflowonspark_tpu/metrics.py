@@ -142,11 +142,15 @@ class TimeHistory(object):
 
     def avg_examples_per_second(self):
         log = self.timestamp_log
-        if len(log) < 2:
-            return 0.0
-        steps = log[-1][0] - log[0][0]
-        elapsed = log[-1][1] - log[0][1]
-        return self.batch_size * steps / elapsed if elapsed > 0 else 0.0
+        if len(log) >= 2:
+            steps = log[-1][0] - log[0][0]
+            elapsed = log[-1][1] - log[0][1]
+            return self.batch_size * steps / elapsed if elapsed > 0 else 0.0
+        if self.elapsed and self.global_steps:
+            # run shorter than one log window: fall back to the (synced)
+            # whole-run elapsed from on_train_end
+            return self.batch_size * self.global_steps / self.elapsed
+        return 0.0
 
     def build_stats(self, loss=None, eval_loss=None, accuracy=None):
         eps = self.avg_examples_per_second()

@@ -123,6 +123,16 @@ class Trainer(object):
             step_flops=flops)
         return flops
 
+    def reset_history(self):
+        """Replace the metrics recorder with a fresh one (same measured step
+        FLOPs), so compile/warmup steps don't pollute the reported stats.
+        No-op before the first step."""
+        if self.history is not None:
+            self.history = metrics_mod.TimeHistory(
+                batch_size=self.batch_size or 0, log_steps=self.log_steps,
+                step_flops=self.history.step_flops)
+            self.history.on_train_begin()
+
     def step(self, batch, mask=None):
         """Run one global step; returns (loss, aux)."""
         if mask is None:

@@ -117,19 +117,18 @@ class ShardedFeed(object):
         """
         stop = self._stop = threading.Event()
         source = (self._prefetched_locals(stop) if self._prefetch_depth
-                  else self._local_iter())
+                  else self._sharded_iter())
         try:
-            for local in source:
-                has_data = local is not None
+            for item in source:
+                has_data = item is not None
                 if not collectives.end_of_data_consensus(self.mesh, has_data):
                     if has_data:
-                        count = local[1]
                         logger.info(
                             "dropping a final partial step (%d local rows): "
-                            "another host exhausted its feed", count)
+                            "another host exhausted its feed", item[2])
                     break
-                arrays, count = local
-                yield self._shard(arrays, count)
+                batch, mask, _ = item
+                yield batch, mask
         finally:
             stop.set()  # wind the prefetch thread down on any exit path
 
@@ -166,10 +165,24 @@ class ShardedFeed(object):
             yield local
         yield None
 
+    def _sharded_iter(self):
+        """Yields device-resident ``(batch, mask, count)`` per step, then a
+        single None at end-of-feed."""
+        for local in self._local_iter():
+            if local is None:
+                yield None
+                return
+            arrays, count = local
+            batch, mask = self._shard(arrays, count)
+            yield batch, mask, count
+
     def _prefetched_locals(self, stop):
-        """Host-thread prefetch: overlap queue drain + numpy assembly with the
-        device step (double buffering by default).  ``stop`` aborts the
-        producer when the consumer exits early (max_steps / consensus)."""
+        """Host-thread prefetch: overlap queue drain, numpy assembly AND the
+        host->device transfer with the device step (double buffering by
+        default — each prefetched batch is already device-resident, so the
+        accelerator never waits on PCIe/transport; costs ``prefetch`` extra
+        batches of HBM).  ``stop`` aborts the producer when the consumer
+        exits early (max_steps / consensus)."""
         buf = _queue.Queue(maxsize=self._prefetch_depth)
 
         def _put(item):
@@ -186,8 +199,8 @@ class ShardedFeed(object):
             # the buffer so the consumer re-raises instead of blocking forever
             # on a producer that died without its None sentinel.
             try:
-                for local in self._local_iter():
-                    if not _put(local):
+                for item in self._sharded_iter():
+                    if not _put(item):
                         return
             except BaseException as exc:  # noqa: B036 — relayed, not handled
                 _put(exc)

@@ -34,6 +34,7 @@ import queue as _queue
 import shutil
 import tempfile
 import threading
+import time
 import traceback
 
 import cloudpickle
@@ -79,6 +80,21 @@ class JobHandle(object):
             self._completed += 1
             if self._completed >= self.num_tasks or not ok:
                 self._done.set()
+
+    def _set_progress(self, completed):
+        """Monotonically update the completed-task count from an external
+        progress source (Spark statusTracker) without firing completion —
+        task results/errors still arrive via ``_task_done``."""
+        with self._lock:
+            if completed > self._completed:
+                self._completed = min(completed, self.num_tasks)
+
+    def _finish_ok(self):
+        """Mark the whole job successfully finished (backends that only
+        observe job-level completion, e.g. Spark's ``foreachPartition``)."""
+        with self._lock:
+            self._completed = self.num_tasks
+            self._done.set()
 
     def done(self):
         return self._done.is_set()
@@ -276,17 +292,57 @@ class SparkBackend(object):
     def foreach_partition_async(self, partitions, fn):
         rdd = self._to_rdd(partitions)
         handle = JobHandle(rdd.getNumPartitions())
+        # uuid, not id(): a freed handle's address can be reused, and a
+        # recycled group name would let statusTracker count a PRIOR job's
+        # completed tasks into this handle's progress.
+        import uuid
+
+        job_group = "tfos-{}".format(uuid.uuid4().hex)
 
         def _run():
+            # Job group scopes the statusTracker queries below to this job
+            # (setJobGroup is thread-local, so it must be set in the thread
+            # that triggers the action).
+            self.sc.setJobGroup(job_group, "tensorflowonspark_tpu job")
             try:
                 rdd.foreachPartition(fn)
-                for i in range(handle.num_tasks):
-                    handle._task_done(i, True, None)
+                handle._finish_ok()
             except Exception:
                 handle._task_done(0, False, traceback.format_exc())
 
-        threading.Thread(target=_run, name="spark-job", daemon=True).start()
+        t = threading.Thread(target=_run, name="spark-job", daemon=True)
+        t.start()
+        threading.Thread(target=self._track_progress,
+                         args=(job_group, handle),
+                         name="spark-job-progress", daemon=True).start()
         return handle
+
+    def _track_progress(self, job_group, handle):
+        """Feed per-task completion counts into the JobHandle while the job
+        runs (reference statusTracker active-task polling,
+        ``TFCluster.py:152-167``).
+
+        Without this, ``_completed`` would only move when the WHOLE job ends
+        — and a job whose ps/evaluator tasks park forever never ends, so
+        FILES-mode shutdown (which waits for ``_completed >= num_workers``)
+        would spin until the SIGALRM watchdog.
+        """
+        while not handle.done():
+            try:
+                st = self.sc.statusTracker()
+                completed = 0
+                for job_id in st.getJobIdsForGroup(job_group):
+                    info = st.getJobInfo(job_id)
+                    if info is None:
+                        continue
+                    for stage_id in info.stageIds:
+                        si = st.getStageInfo(stage_id)
+                        if si is not None:
+                            completed += si.numCompletedTasks
+                handle._set_progress(completed)
+            except Exception:
+                logger.debug("statusTracker poll failed", exc_info=True)
+            time.sleep(1)
 
     def foreach_partition(self, partitions, fn, timeout=None):
         self.foreach_partition_async(partitions, fn).wait(timeout)

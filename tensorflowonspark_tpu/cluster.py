@@ -61,16 +61,30 @@ class TPUCluster(object):
         - a list of partitions (built-in backend) or an RDD (Spark backend);
           epochs are fed by repeating the partition list (reference
           ``sc.union([rdd]*num_epochs)``, ``TFCluster.py:88-91``);
-        - an *iterator/generator of partitions* for streaming: fed until
-          exhausted or a STOP is requested (reference DStream branch,
-          ``TFCluster.py:81-83``).
+        - a Spark Streaming DStream: every micro-batch RDD is fed as its own
+          feed job until STOP (reference DStream branch, ``TFCluster.py:81-83``;
+          pair with ``shutdown(ssc=...)``);
+        - an *iterator/generator of partitions* for streaming without Spark:
+          fed until exhausted or a STOP is requested.
         """
         logger.info("Feeding training data")
         assert self.input_mode == InputMode.SPARK, \
             "train() feeding requires InputMode.SPARK"
         assert num_epochs >= 0
         fn = node.train(self.cluster_info, self.cluster_meta, qname, feed_timeout)
-        if hasattr(data, "__next__"):  # streaming source: unbounded partitions
+        if hasattr(data, "foreachRDD"):  # Spark Streaming DStream
+            cluster = self
+
+            def _feed_batch(rdd):
+                # Runs on the streaming scheduler thread, once per interval.
+                # After STOP, micro-batches keep arriving until the user's
+                # awaitTermination loop (shutdown(ssc=...)) stops the
+                # context; don't feed them into terminating nodes.
+                if not cluster.server.done:
+                    rdd.foreachPartition(fn)
+
+            data.foreachRDD(_feed_batch)
+        elif hasattr(data, "__next__"):  # streaming source: unbounded partitions
             for part in data:
                 if self.server.done:
                     logger.info("STOP requested; ending streaming feed")
@@ -100,10 +114,14 @@ class TPUCluster(object):
 
     # -- lifecycle --------------------------------------------------------
 
-    def shutdown(self, grace_secs=0, timeout=259200):
+    def shutdown(self, ssc=None, grace_secs=0, timeout=259200):
         """Stop the cluster and surface any node errors (reference
         ``TFCluster.py:115-200``).
 
+        For Spark Streaming apps pass ``ssc``: blocks in an
+        ``awaitTerminationOrTimeout`` loop until an external STOP reaches
+        the reservation server, then stops the StreamingContext gracefully
+        (reference ``TFCluster.py:145-151``).
         For FILES mode, waits for worker node tasks to finish their user fn
         first (reference statusTracker polling, ``TFCluster.py:152-167``).
         Exits the driver with status 1 if any node raised (reference
@@ -127,6 +145,17 @@ class TPUCluster(object):
                    if n["job_name"] in ("ps", "evaluator")]
         workers = [n for n in self.cluster_info
                    if n["job_name"] in ("chief", "master", "worker")]
+
+        if ssc is not None:
+            # Spark Streaming: keep the context alive until a STOP arrives
+            # at the reservation server (external stop CLI or a node's
+            # request_stop), then stop it gracefully (reference
+            # TFCluster.py:145-151).
+            while not ssc.awaitTerminationOrTimeout(1):
+                if self.server.done:
+                    logger.info("STOP received; stopping StreamingContext")
+                    ssc.stop(stopSparkContext=False, stopGraceFully=True)
+                    break
 
         if self.input_mode == InputMode.FILES:
             # Workers run the user fn inline in their start task; wait for

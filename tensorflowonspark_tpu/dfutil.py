@@ -20,18 +20,11 @@ guessed from the value count (len 1 = scalar) — documented lossy
 import glob
 import logging
 import os
+import weakref
 
 from tensorflowonspark_tpu import example_proto, tfrecord
 
 logger = logging.getLogger(__name__)
-
-def isLoadedDF(rows):
-    """True if ``rows`` came from :func:`load_tfrecords` (reference
-    ``dfutil.py:18-26``, which tracked provenance in a ``loadedDF`` dict;
-    here provenance rides on the :class:`Rows` object itself — a global
-    id-keyed table would leak and give false positives on recycled ids)."""
-    return getattr(rows, "source_dir", None) is not None
-
 
 class Rows(list):
     """A list of row dicts with an attached ``schema`` ({col: type}) and,
@@ -157,6 +150,141 @@ def load_tfrecords(input_dir, binary_features=(), schema=None):
     out.schema = schema or {}
     out.source_dir = input_dir
     return out
+
+
+# ---------------------------------------------------------------------------
+# Spark-DataFrame-native save/load (reference ``dfutil.py:29-81``), no JVM:
+# executors run the first-party TFRecord codec per partition.
+# ---------------------------------------------------------------------------
+
+# DataFrame provenance (reference ``loadedDF`` dict, ``dfutil.py:15-26``):
+# weak-keyed by the DataFrame object so entries die with their DataFrames
+# (an id-keyed table would leak and give false positives on recycled ids).
+loadedDF = weakref.WeakKeyDictionary()
+
+
+def isLoadedDF(df_or_rows):
+    """True if the DataFrame/Rows came from :func:`load_tfrecords` /
+    :func:`loadTFRecords` (reference ``dfutil.py:18-26``).
+
+    Order matters: a pyspark DataFrame's ``__getattr__`` resolves COLUMN
+    names, so a user DF with a ``source_dir`` column would answer a plain
+    attribute probe — check the provenance dict and the Rows type instead.
+    """
+    try:
+        if df_or_rows in loadedDF:
+            return True
+    except TypeError:  # unhashable dataset types are never loaded DFs
+        pass
+    return isinstance(df_or_rows, Rows) and df_or_rows.source_dir is not None
+
+
+def _spark_type_to_dfutil(dataType, binary_features=(), name=""):
+    """Map a ``pyspark.sql.types.DataType`` to a dfutil type string via its
+    ``simpleString`` (the same SQL-name table the schema-hint parser uses)."""
+    from tensorflowonspark_tpu import schema as schema_mod
+
+    simple = dataType.simpleString()
+    coltype = schema_mod._parse_type(simple)
+    if coltype == "string" and name in binary_features:
+        coltype = "binary"
+    return coltype
+
+
+def _dfutil_type_to_spark(coltype):
+    from pyspark.sql import types as T
+
+    base = _base_type(coltype)
+    spark_base = {"int64": T.LongType(), "float32": T.FloatType(),
+                  "string": T.StringType(), "binary": T.BinaryType()}[base]
+    if coltype.startswith("array<"):
+        return T.ArrayType(spark_base)
+    return spark_base
+
+
+def df_schema(df, binary_features=()):
+    """{col: dfutil type} from a DataFrame's SQL schema (reference derived
+    Example kinds from the DataFrame schema, ``dfutil.py:99-103``)."""
+    return {f.name: _spark_type_to_dfutil(f.dataType, binary_features, f.name)
+            for f in df.schema.fields}
+
+
+def saveAsTFRecords(df, output_dir, binary_features=()):
+    """Save a Spark DataFrame as TFRecords under ``output_dir`` (reference
+    ``saveAsTFRecords``, ``dfutil.py:29-41``): one part file per partition,
+    written by the executors with the first-party codec (no Hadoop jar).
+    ``output_dir`` must be on storage shared by driver and executors."""
+    schema = df_schema(df, binary_features)
+    columns = [f.name for f in df.schema.fields]
+    os.makedirs(output_dir, exist_ok=True)
+
+    def _write_part(index, iterator):
+        from tensorflowonspark_tpu import dfutil as dfutil_mod
+        from tensorflowonspark_tpu import tfrecord as tfr_mod
+
+        path = os.path.join(output_dir, "part-r-{:05d}".format(index))
+        count = 0
+        with tfr_mod.TFRecordWriter(path) as w:
+            for row in iterator:
+                rowd = dict(zip(columns, row))
+                w.write(dfutil_mod.to_example(rowd, schema))
+                count += 1
+        return [count]
+
+    counts = df.rdd.mapPartitionsWithIndex(_write_part).collect()
+    logger.info("saved %d rows to %d part files in %s",
+                sum(counts), len(counts), output_dir)
+
+
+def loadTFRecords(sc, input_dir, binary_features=(), schema_hint=None):
+    """Load TFRecords under ``input_dir`` as a Spark DataFrame (reference
+    ``loadTFRecords``, ``dfutil.py:44-81``): schema inferred by probing the
+    first record on the driver (reference ``take(1)`` probe, 68-71) unless a
+    schema hint (dfutil dict or ``struct<...>`` string) overrides it; rows
+    decoded by the executors.  Records provenance in :data:`loadedDF`."""
+    from pyspark.sql import SparkSession
+    from pyspark.sql import types as T
+
+    paths = sorted(glob.glob(os.path.join(input_dir, "part-*")))
+    if not paths:
+        paths = sorted(glob.glob(os.path.join(input_dir, "*.tfrecord*")))
+    if not paths:
+        raise IOError("no TFRecord part files under {}".format(input_dir))
+
+    if isinstance(schema_hint, str):
+        from tensorflowonspark_tpu import schema as schema_mod
+
+        schema_hint = schema_mod.parse(schema_hint)
+    schema = schema_hint
+    if schema is None:
+        probe = None
+        for path in paths:  # first part files may be empty (empty partitions)
+            probe = next(tfrecord.tfrecord_iterator(path), None)
+            if probe is not None:
+                break
+        if probe is None:
+            raise IOError("no records under {}".format(input_dir))
+        schema = infer_schema(probe, binary_features)
+        logger.info("inferred schema: %s", schema)
+    columns = list(schema)
+    spark_schema = T.StructType([
+        T.StructField(name, _dfutil_type_to_spark(coltype), True)
+        for name, coltype in schema.items()])
+
+    def _read_part(path_iter):
+        from tensorflowonspark_tpu import dfutil as dfutil_mod
+        from tensorflowonspark_tpu import tfrecord as tfr_mod
+
+        for path in path_iter:
+            for record in tfr_mod.tfrecord_iterator(path):
+                row = dfutil_mod.from_example(record, schema)
+                yield tuple(row[c] for c in columns)
+
+    rdd = sc.parallelize(paths, len(paths)).mapPartitions(_read_part)
+    spark = SparkSession.builder.getOrCreate()
+    df = spark.createDataFrame(rdd, spark_schema)
+    loadedDF[df] = input_dir
+    return df
 
 
 def infer_row_schema(row):

@@ -27,6 +27,21 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+# pyspark.ml compatibility (reference ``pipeline.py:330-446`` subclasses
+# ``pyspark.ml.Estimator/Model`` so TFoS stages compose into ML Pipelines):
+# when pyspark is importable, TFEstimator/TFModel are real pipeline stages
+# (ABCMeta + Params machinery + _fit/_transform dispatch); otherwise they
+# degrade to plain framework classes with the same user-facing API.
+try:
+    from pyspark.ml import Estimator as _MLEstimator
+    from pyspark.ml import Model as _MLModel
+
+    HAS_PYSPARK_ML = True
+except Exception:  # pyspark absent: framework-only classes
+    _MLEstimator = object
+    _MLModel = object
+    HAS_PYSPARK_ML = False
+
 # Process-global model cache for transform executors (reference
 # ``pipeline.py:449-451``): survives across partitions on the same executor.
 _model_cache = {}
@@ -88,19 +103,23 @@ class TFParams(object):
     the reference's ``TFParams`` + ``Has*`` mixins)."""
 
     def __init__(self, **kwargs):
-        self._params = {name: default for name, (default, _) in PARAMS.items()}
+        self._tfos_params = {name: default for name, (default, _) in PARAMS.items()}
         for key, val in kwargs.items():
             self.set(key, val)
+        # Cooperative init: when a subclass also derives from
+        # pyspark.ml.Estimator/Model, this initializes the Params/uid
+        # machinery those base classes need.
+        super(TFParams, self).__init__()
 
     def set(self, name, value):
         if name not in PARAMS:
             raise KeyError("unknown param {!r}; known: {}".format(
                 name, sorted(PARAMS)))
-        self._params[name] = value
+        self._tfos_params[name] = value
         return self
 
     def get(self, name):
-        return self._params[name]
+        return self._tfos_params[name]
 
     def __getattr__(self, name):
         # setBatchSize/getBatchSize-style accessors for reference familiarity
@@ -117,7 +136,7 @@ class TFParams(object):
         """Merge this object's params over an args Namespace: params set here
         win, args fill the rest (reference ``pipeline.py:318-327``)."""
         merged = Namespace(args)
-        for name, value in self._params.items():
+        for name, value in self._tfos_params.items():
             setattr(merged, name, value)
         return merged
 
@@ -148,9 +167,13 @@ def _dataset_rows(dataset, input_columns=None):
 # Estimator
 # ---------------------------------------------------------------------------
 
-class TFEstimator(TFParams):
+class TFEstimator(TFParams, _MLEstimator):
     """Trains a model on a dataset via a framework cluster and returns a
     :class:`TFModel` (reference ``TFEstimator``, ``pipeline.py:330-391``).
+
+    When pyspark is installed this is a real ``pyspark.ml.Estimator``, so it
+    composes into ``pyspark.ml.Pipeline`` alongside other stages (reference
+    ``pipeline.py:330``); without pyspark the same API works standalone.
 
     Args:
       train_fn: user ``main_fun(args, ctx)`` run on every node; reads its
@@ -167,9 +190,15 @@ class TFEstimator(TFParams):
         self.args = Namespace(tf_args)
         self.backend = backend
 
-    def fit(self, dataset):
+    def fit(self, dataset, params=None):
         """Spawn a cluster, feed the dataset, return a TFModel (reference
         ``pipeline.py:367-391``)."""
+        if HAS_PYSPARK_ML and params is not None:
+            # defer to pyspark's fit() param-map handling -> calls _fit
+            return _MLEstimator.fit(self, dataset, params)
+        return self._fit(dataset)
+
+    def _fit(self, dataset):
         from tensorflowonspark_tpu import cluster as cluster_mod
 
         local_args = self.merge_args_params(self.args)
@@ -203,9 +232,13 @@ class TFEstimator(TFParams):
 # Model
 # ---------------------------------------------------------------------------
 
-class TFModel(TFParams):
+class TFModel(TFParams, _MLModel):
     """Batched, cached, per-executor model inference over a dataset
     (reference ``TFModel``, ``pipeline.py:394-446``).
+
+    When pyspark is installed this is a real ``pyspark.ml.Model`` pipeline
+    stage; ``transform(df)`` then returns a DataFrame with the prediction
+    column (reference ``_transform`` builds one, ``pipeline.py:445-446``).
 
     Loads the framework export (``export_dir``) on each executor — model
     rebuilt from the registry via the export descriptor, params from orbax —
@@ -219,13 +252,23 @@ class TFModel(TFParams):
         if args is not None:  # inherit estimator params (reference TFModel(args))
             for name in PARAMS:
                 if name in args:
-                    self._params[name] = getattr(args, name)
+                    self._tfos_params[name] = getattr(args, name)
         self.backend = backend
 
-    def transform(self, dataset, num_partitions=None):
-        """Run inference over the dataset; returns a list of output rows (or
-        an RDD when the dataset is a Spark DataFrame)
-        (reference ``_transform``, ``pipeline.py:419-446``)."""
+    def transform(self, dataset, params=None, num_partitions=None):
+        """Run inference over the dataset (reference ``_transform``,
+        ``pipeline.py:419-446``).  Returns a DataFrame (prediction column
+        appended per the output_mapping) when given a DataFrame, else a list
+        of output rows."""
+        if HAS_PYSPARK_ML and params is not None:
+            return _MLModel.transform(self, dataset, params)
+        return self._transform(dataset, num_partitions)
+
+    def _output_column(self):
+        out_map = self.get("output_mapping")
+        return next(iter(out_map.values())) if out_map else "prediction"
+
+    def _transform(self, dataset, num_partitions=None):
         from tensorflowonspark_tpu import backend as backend_mod
 
         export_dir = self.get("export_dir") or self.get("model_dir")
@@ -236,7 +279,13 @@ class TFModel(TFParams):
         run = _run_model_fn(export_dir, self.get("batch_size"))
 
         if hasattr(rows, "mapPartitions"):  # Spark RDD path
-            return rows.mapPartitions(run)
+            out_rdd = rows.mapPartitions(run)
+            spark = getattr(dataset, "sparkSession", None)
+            if spark is None:
+                return out_rdd
+            # DataFrame in -> DataFrame out (reference pipeline.py:445-446)
+            return spark.createDataFrame(out_rdd.map(lambda p: (p,)),
+                                         [self._output_column()])
         num_partitions = num_partitions or getattr(
             self.backend, "num_executors", 1)
         parts = backend_mod.partition(rows, num_partitions)

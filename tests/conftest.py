@@ -15,6 +15,18 @@ PJRT plugin via sitecustomize that prepends itself to ``jax_platforms``:
 """
 
 import os
+import sys
+
+# pyspark shim (tests/sparkshim): a process-backed test double of the exact
+# pyspark API surface the framework's Spark layer consumes.  On the path for
+# the WHOLE suite (before any framework import) so import-gated pyspark code
+# (pipeline ml-subclassing, SparkBackend, DataFrame dfutil) is active and
+# exercised; PYTHONPATH propagates it to spawned executor processes.
+_SHIM = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sparkshim")
+if _SHIM not in sys.path:
+    sys.path.insert(0, _SHIM)
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    p for p in (_SHIM, os.environ.get("PYTHONPATH", "")) if p)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""  # de-activate TPU plugin hook in children

@@ -1,0 +1,251 @@
+"""Minimal pyspark-compatible shim for testing the framework's Spark layer.
+
+The image has no pyspark, but the framework's Spark-facing code
+(``backend.SparkBackend``, DataFrame dfutil, pyspark.ml pipeline stages,
+DStream streaming) must be *executed*, not just imported.  This shim
+implements the exact pyspark API surface the framework consumes, with the
+semantics that matter for those paths:
+
+- executors are REAL separate long-lived processes (one task slot each),
+  via the framework's LocalBackend — the same properties a local Spark
+  Standalone cluster gives the reference's test rig (reference
+  ``test/README.md:10``);
+- RDDs are lazy over materialized partitions; actions dispatch one task per
+  partition to the executor processes;
+- ``statusTracker`` exposes per-task completion of running jobs, keyed by
+  job group (what ``SparkBackend._track_progress`` polls);
+- task failures propagate out of actions as driver-side exceptions.
+
+It is a test double, not a Spark: no shuffle, no storage levels, no SQL.
+Production code must only use documented pyspark APIs so the same code runs
+against the real thing.
+"""
+
+import os
+import sys
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SHIM_ROOT = os.path.dirname(_HERE)
+_REPO_ROOT = os.path.dirname(os.path.dirname(_SHIM_ROOT))
+
+
+class SparkConf(object):
+    def __init__(self):
+        self._conf = {}
+
+    def set(self, key, value):
+        self._conf[key] = str(value)
+        return self
+
+    def setMaster(self, master):
+        return self.set("spark.master", master)
+
+    def setAppName(self, name):
+        return self.set("spark.app.name", name)
+
+    def get(self, key, defaultValue=None):
+        return self._conf.get(key, defaultValue)
+
+
+class _JobInfo(object):
+    def __init__(self, job_id, stage_ids):
+        self.jobId = job_id
+        self.stageIds = list(stage_ids)
+
+
+class _StageInfo(object):
+    def __init__(self, stage_id, num_tasks, num_completed, num_active):
+        self.stageId = stage_id
+        self.numTasks = num_tasks
+        self.numCompletedTasks = num_completed
+        self.numActiveTasks = num_active
+        self.numFailedTasks = 0
+
+
+class StatusTracker(object):
+    def __init__(self, sc):
+        self._sc = sc
+
+    def getJobIdsForGroup(self, jobGroup=None):
+        with self._sc._jobs_lock:
+            return [jid for jid, job in self._sc._jobs.items()
+                    if job["group"] == jobGroup]
+
+    def getActiveJobsIds(self):
+        with self._sc._jobs_lock:
+            return [jid for jid, job in self._sc._jobs.items()
+                    if not job["handle"].done()]
+
+    def getJobInfo(self, jobId):
+        with self._sc._jobs_lock:
+            job = self._sc._jobs.get(jobId)
+        return _JobInfo(jobId, [job["stage_id"]]) if job else None
+
+    def getStageInfo(self, stageId):
+        with self._sc._jobs_lock:
+            for job in self._sc._jobs.values():
+                if job["stage_id"] == stageId:
+                    handle = job["handle"]
+                    total = handle.num_tasks
+                    completed = handle._completed
+                    return _StageInfo(stageId, total, completed,
+                                      0 if handle.done() else total - completed)
+        return None
+
+
+class _FakeHadoopConf(object):
+    def get(self, key, default=None):
+        if key == "fs.defaultFS":
+            return "file:///"
+        return default
+
+
+class _FakeJsc(object):
+    def hadoopConfiguration(self):
+        return _FakeHadoopConf()
+
+
+class SparkContext(object):
+    """Driver handle over N separate long-lived executor processes."""
+
+    _active = None
+
+    def __init__(self, master=None, appName=None, conf=None):
+        from tensorflowonspark_tpu import backend as backend_mod
+
+        self._conf = conf or SparkConf()
+        master = master or self._conf.get("spark.master", "local-cluster[2,1,512]")
+        n = self._conf.get("spark.executor.instances")
+        if n is None and master.startswith("local-cluster["):
+            n = master[len("local-cluster["):-1].split(",")[0]
+        self.num_executors = int(n or 2)
+        self._conf.set("spark.executor.instances", self.num_executors)
+        # children must resolve this shim's `pyspark` and the repo package
+        pypath = os.pathsep.join(
+            p for p in (_SHIM_ROOT, _REPO_ROOT,
+                        os.environ.get("PYTHONPATH", "")) if p)
+        self._backend = backend_mod.LocalBackend(
+            self.num_executors, env={"PYTHONPATH": pypath})
+        self._jsc = _FakeJsc()
+        self._jobs = {}
+        self._jobs_lock = threading.Lock()
+        self._next_job_id = [0]
+        self._job_group = threading.local()
+        SparkContext._active = self
+
+    # -- conf / lifecycle --------------------------------------------------
+
+    def getConf(self):
+        return self._conf
+
+    def statusTracker(self):
+        return StatusTracker(self)
+
+    def setJobGroup(self, groupId, description=None, interruptOnCancel=False):
+        self._job_group.value = groupId
+
+    def cancelAllJobs(self):
+        pass
+
+    def stop(self):
+        self._backend.stop()
+        if SparkContext._active is self:
+            SparkContext._active = None
+
+    # -- data --------------------------------------------------------------
+
+    def parallelize(self, data, numSlices=None):
+        from tensorflowonspark_tpu import backend as backend_mod
+
+        numSlices = numSlices or self.num_executors
+        return RDD(self, backend_mod.partition(list(data), numSlices))
+
+    def union(self, rdds):
+        parts = []
+        for rdd in rdds:
+            parts.extend(rdd._localize())
+        return RDD(self, parts)
+
+    # -- job execution (internal) -----------------------------------------
+
+    def _run_job(self, rdd, action, timeout=None):
+        """Run ``action(index, iterator) -> list`` over every partition on
+        the executor processes; returns per-partition results.  Registers
+        the job for statusTracker and raises on task failure."""
+        ops = rdd._ops
+        indexed = [[(i, part)] for i, part in enumerate(rdd._parts)]
+
+        def _task(it):
+            index, items = next(it)
+            iterator = iter(items)
+            for kind, fn in ops:
+                if kind == "mp":
+                    iterator = fn(iterator)
+                elif kind == "mpi":
+                    iterator = fn(index, iterator)
+                else:  # map
+                    iterator = map(fn, iterator)
+            return list(action(index, iterator))
+
+        handle = self._backend.foreach_partition_async(indexed, _task)
+        group = getattr(self._job_group, "value", None)
+        with self._jobs_lock:
+            job_id = self._next_job_id[0]
+            self._next_job_id[0] += 1
+            self._jobs[job_id] = {"group": group, "handle": handle,
+                                  "stage_id": job_id}
+        return handle.wait(timeout)
+
+
+class RDD(object):
+    """Lazy transform chain over materialized partitions."""
+
+    def __init__(self, sc, parts, ops=()):
+        self._sc = sc
+        self._parts = [list(p) for p in parts]
+        self._ops = tuple(ops)
+
+    def getNumPartitions(self):
+        return len(self._parts)
+
+    def mapPartitions(self, f, preservesPartitioning=False):
+        return RDD(self._sc, self._parts, self._ops + (("mp", f),))
+
+    def mapPartitionsWithIndex(self, f, preservesPartitioning=False):
+        return RDD(self._sc, self._parts, self._ops + (("mpi", f),))
+
+    def map(self, f, preservesPartitioning=False):
+        return RDD(self._sc, self._parts, self._ops + (("map", f),))
+
+    def foreachPartition(self, f):
+        def _action(index, iterator):
+            f(iterator)
+            return []
+
+        self._sc._run_job(self, _action)
+
+    def collect(self):
+        results = self._sc._run_job(self, lambda i, it: list(it))
+        return [item for part in results if part for item in part]
+
+    def count(self):
+        return len(self.collect())
+
+    def _localize(self):
+        """Materialize the transform chain driver-side (shim helper for
+        ``sc.union``; plain parallelized RDDs pass through untouched)."""
+        if not self._ops:
+            return self._parts
+        out = []
+        for index, part in enumerate(self._parts):
+            iterator = iter(part)
+            for kind, fn in self._ops:
+                if kind == "mp":
+                    iterator = fn(iterator)
+                elif kind == "mpi":
+                    iterator = fn(index, iterator)
+                else:
+                    iterator = map(fn, iterator)
+            out.append(list(iterator))
+        return out

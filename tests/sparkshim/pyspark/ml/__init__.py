@@ -1,0 +1,90 @@
+"""pyspark.ml shim: the Estimator/Model/Pipeline contract (the surface
+``tensorflowonspark_tpu.pipeline`` subclasses and composes into)."""
+
+import copy
+import uuid
+
+
+class Params(object):
+    """Identity + trivial param-map plumbing (enough for Pipeline.fit's
+    stage handling and for subclasses calling super().__init__())."""
+
+    def __init__(self):
+        if not hasattr(self, "uid"):
+            self.uid = "{}_{}".format(type(self).__name__, uuid.uuid4().hex[:12])
+        # Fidelity with real pyspark.ml.param.Params.__init__, which sets
+        # this as its params-property cache: subclasses that store their own
+        # state under self._params get it clobbered by the real thing, so
+        # the shim must clobber it too (regression: TFParams once did).
+        self._params = None
+
+    def copy(self, extra=None):
+        return copy.copy(self)
+
+
+class Transformer(Params):
+    def transform(self, dataset, params=None):
+        return self._transform(dataset)
+
+    def _transform(self, dataset):
+        raise NotImplementedError
+
+
+class Estimator(Params):
+    def fit(self, dataset, params=None):
+        return self._fit(dataset)
+
+    def _fit(self, dataset):
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    pass
+
+
+class PipelineModel(Model):
+    def __init__(self, stages):
+        super(PipelineModel, self).__init__()
+        self.stages = list(stages)
+
+    def _transform(self, dataset):
+        for stage in self.stages:
+            dataset = stage.transform(dataset)
+        return dataset
+
+
+class Pipeline(Estimator):
+    """Real pyspark.ml.Pipeline semantics: every estimator stage is fit;
+    all but the last fitted stage also transform the running dataset so
+    downstream stages train on transformed data."""
+
+    def __init__(self, stages=None):
+        super(Pipeline, self).__init__()
+        self.stages = list(stages or [])
+
+    def getStages(self):
+        return self.stages
+
+    def setStages(self, stages):
+        self.stages = list(stages)
+        return self
+
+    def _fit(self, dataset):
+        last_estimator = -1
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Estimator):
+                last_estimator = i
+        fitted = []
+        for i, stage in enumerate(self.stages):
+            if i <= last_estimator:
+                if isinstance(stage, Estimator):
+                    model = stage.fit(dataset)
+                    fitted.append(model)
+                    if i < last_estimator:
+                        dataset = model.transform(dataset)
+                else:
+                    fitted.append(stage)
+                    dataset = stage.transform(dataset)
+            else:
+                fitted.append(stage)
+        return PipelineModel(fitted)

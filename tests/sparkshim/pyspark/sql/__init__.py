@@ -1,0 +1,139 @@
+"""pyspark.sql shim: SparkSession / DataFrame / Row over the shim RDDs."""
+
+import pyspark
+from pyspark.sql import types as T
+
+
+class Row(tuple):
+    """Tuple with named-field access (the slice of pyspark.sql.Row the
+    framework's save/feed paths iterate over)."""
+
+    def __new__(cls, fields, values):
+        row = super(Row, cls).__new__(cls, values)
+        row._fields = list(fields)
+        return row
+
+    def __getattr__(self, name):
+        try:
+            return self[self._fields.index(name)]
+        except (ValueError, AttributeError):
+            raise AttributeError(name)
+
+    def asDict(self):
+        return dict(zip(self._fields, self))
+
+    def __reduce__(self):
+        # tuple subclasses need explicit pickle support (default reduce
+        # calls cls(*items) and loses _fields)
+        return (Row, (self._fields, tuple(self)))
+
+    def __repr__(self):
+        return "Row({})".format(", ".join(
+            "{}={!r}".format(f, v) for f, v in zip(self._fields, self)))
+
+
+def _infer_type(value):
+    if isinstance(value, bool):
+        return T.LongType()
+    if isinstance(value, int):
+        return T.LongType()
+    if isinstance(value, float):
+        return T.DoubleType()
+    if isinstance(value, (bytes, bytearray)):
+        return T.BinaryType()
+    if isinstance(value, str):
+        return T.StringType()
+    if isinstance(value, (list, tuple)):
+        return T.ArrayType(_infer_type(value[0]) if len(value) else T.NullType())
+    return T.NullType()
+
+
+class DataFrame(object):
+    def __init__(self, rdd, schema, spark):
+        self._rdd = rdd
+        self.schema = schema
+        self.sparkSession = spark
+
+    @property
+    def columns(self):
+        return [f.name for f in self.schema.fields]
+
+    @property
+    def rdd(self):
+        cols = self.columns
+        return self._rdd.map(lambda values: Row(cols, values))
+
+    def select(self, *cols):
+        if len(cols) == 1 and isinstance(cols[0], (list, tuple)):
+            cols = list(cols[0])
+        else:
+            cols = list(cols)
+        current = self.columns
+        idxs = [current.index(c) for c in cols]
+        schema = T.StructType([self.schema.fields[i] for i in idxs])
+        projected = self._rdd.map(
+            lambda values: tuple(values[i] for i in idxs))
+        return DataFrame(projected, schema, self.sparkSession)
+
+    def collect(self):
+        cols = self.columns
+        return [Row(cols, values) for values in self._rdd.collect()]
+
+    def count(self):
+        return self._rdd.count()
+
+
+class SparkSession(object):
+    _instance = None
+
+    def __init__(self, sc):
+        self.sparkContext = sc
+        SparkSession._instance = self
+
+    class _Builder(object):
+        def getOrCreate(self):
+            if (SparkSession._instance is not None and
+                    SparkSession._instance.sparkContext is
+                    pyspark.SparkContext._active and
+                    pyspark.SparkContext._active is not None):
+                return SparkSession._instance
+            sc = pyspark.SparkContext._active or pyspark.SparkContext()
+            return SparkSession(sc)
+
+        def master(self, m):
+            return self
+
+        def appName(self, n):
+            return self
+
+        def config(self, *a, **k):
+            return self
+
+    builder = _Builder()
+
+    def createDataFrame(self, data, schema=None):
+        """Accepts an RDD or list of tuples/Rows/dicts; schema may be a
+        StructType, a list of column names, or None (inferred)."""
+        if isinstance(data, pyspark.RDD):
+            rdd = data.map(tuple)
+            sample = rdd.collect()[:1]
+        else:
+            rows = list(data)
+            if rows and isinstance(rows[0], dict):
+                names = sorted(rows[0])
+                rows = [tuple(r[n] for n in names) for r in rows]
+                if schema is None:
+                    schema = names
+            rows = [tuple(r) for r in rows]
+            rdd = self.sparkContext.parallelize(rows)
+            rdd = pyspark.RDD(self.sparkContext, rdd._parts)
+            sample = rows[:1]
+        if schema is None or isinstance(schema, (list, tuple)):
+            if not sample:
+                raise ValueError("cannot infer schema from empty data")
+            names = (list(schema) if schema is not None
+                     else ["_{}".format(i + 1) for i in range(len(sample[0]))])
+            schema = T.StructType([
+                T.StructField(n, _infer_type(v))
+                for n, v in zip(names, sample[0])])
+        return DataFrame(rdd, schema, self)

@@ -1,0 +1,90 @@
+"""pyspark.sql.types shim: the type objects the framework's dfutil maps
+to/from (``simpleString`` is the contract ``dfutil.df_schema`` consumes)."""
+
+
+class DataType(object):
+    def simpleString(self):
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.__dict__ == other.__dict__
+
+    def __repr__(self):
+        return type(self).__name__ + "()"
+
+
+class LongType(DataType):
+    def simpleString(self):
+        return "bigint"
+
+
+class IntegerType(DataType):
+    def simpleString(self):
+        return "int"
+
+
+class FloatType(DataType):
+    def simpleString(self):
+        return "float"
+
+
+class DoubleType(DataType):
+    def simpleString(self):
+        return "double"
+
+
+class StringType(DataType):
+    def simpleString(self):
+        return "string"
+
+
+class BinaryType(DataType):
+    def simpleString(self):
+        return "binary"
+
+
+class NullType(DataType):
+    def simpleString(self):
+        return "void"
+
+
+class ArrayType(DataType):
+    def __init__(self, elementType, containsNull=True):
+        self.elementType = elementType
+        self.containsNull = containsNull
+
+    def simpleString(self):
+        return "array<{}>".format(self.elementType.simpleString())
+
+    def __repr__(self):
+        return "ArrayType({!r})".format(self.elementType)
+
+
+class StructField(object):
+    def __init__(self, name, dataType, nullable=True):
+        self.name = name
+        self.dataType = dataType
+        self.nullable = nullable
+
+    def __repr__(self):
+        return "StructField({!r}, {!r})".format(self.name, self.dataType)
+
+
+class StructType(DataType):
+    def __init__(self, fields=None):
+        self.fields = list(fields or [])
+
+    @property
+    def names(self):
+        return [f.name for f in self.fields]
+
+    def simpleString(self):
+        return "struct<{}>".format(",".join(
+            "{}:{}".format(f.name, f.dataType.simpleString())
+            for f in self.fields))
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def __repr__(self):
+        return "StructType({!r})".format(self.fields)

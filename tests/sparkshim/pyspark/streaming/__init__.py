@@ -1,0 +1,66 @@
+"""pyspark.streaming shim: StreamingContext + queueStream DStream (the
+surface the framework's DStream feed branch and shutdown(ssc=...) loop use)."""
+
+import logging
+import threading
+
+logger = logging.getLogger(__name__)
+
+
+class DStream(object):
+    def __init__(self, ssc):
+        self._ssc = ssc
+        self._callbacks = []
+
+    def foreachRDD(self, func):
+        self._callbacks.append(func)
+
+
+class StreamingContext(object):
+    """Micro-batch scheduler: every ``batchDuration`` seconds, pops the next
+    queued RDD and invokes the registered foreachRDD callbacks — on a
+    scheduler thread, like the real streaming job generator."""
+
+    def __init__(self, sparkContext, batchDuration=1.0):
+        self.sparkContext = sparkContext
+        self.batchDuration = batchDuration
+        self._queue = []
+        self._queue_lock = threading.Lock()
+        self._streams = []
+        self._stopped = threading.Event()
+        self._thread = None
+
+    def queueStream(self, rdds, oneAtATime=True, default=None):
+        stream = DStream(self)
+        with self._queue_lock:
+            self._queue.extend(rdds)
+        self._streams.append(stream)
+        return stream
+
+    def start(self):
+        def _scheduler():
+            while not self._stopped.wait(self.batchDuration):
+                with self._queue_lock:
+                    rdd = self._queue.pop(0) if self._queue else None
+                if rdd is None:
+                    continue
+                for stream in self._streams:
+                    for cb in stream._callbacks:
+                        try:
+                            cb(rdd)
+                        except Exception:
+                            logger.exception("foreachRDD callback failed")
+
+        self._thread = threading.Thread(target=_scheduler,
+                                        name="shim-streaming", daemon=True)
+        self._thread.start()
+
+    def awaitTerminationOrTimeout(self, timeout):
+        return self._stopped.wait(timeout)
+
+    def stop(self, stopSparkContext=True, stopGraceFully=False):
+        self._stopped.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if stopSparkContext:
+            self.sparkContext.stop()

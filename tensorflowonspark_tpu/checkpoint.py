@@ -84,8 +84,8 @@ class CheckpointManager(object):
             return False  # already saved (e.g. final force after interval hit)
         import orbax.checkpoint as ocp
 
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
-                               force=force)
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(
+            _globalize(state)), force=force)
         if saved:
             logger.info("checkpointed step %d to %s", step, self.directory)
         return saved
@@ -108,6 +108,37 @@ class CheckpointManager(object):
 
     def close(self):
         self._mgr.close()
+
+
+def _globalize(tree):
+    """Make every leaf serializable in multi-host worlds.
+
+    Orbax refuses host-local ``jax.Array`` leaves when
+    ``process_count() > 1`` (e.g. a bare ``jnp.asarray(step)`` counter that
+    never went through a mesh sharding).  Such leaves are per-host values
+    that are identical across hosts by construction (step counters, scalars
+    computed from the replicated state), so re-wrap them as globally
+    replicated arrays over all devices.  Mesh-sharded/global leaves pass
+    through untouched.  No-op in single-process worlds.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return tree
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.asarray(jax.devices()), ("_ckpt",))
+    replicated = NamedSharding(mesh, PartitionSpec())
+
+    def one(x):
+        if isinstance(x, jax.Array) and x.is_fully_addressable:
+            host = np.asarray(jax.device_get(x))
+            return jax.make_array_from_callback(
+                host.shape, replicated, lambda idx: host[idx])
+        return x
+
+    return jax.tree_util.tree_map(one, tree)
 
 
 def should_export(ctx):
@@ -142,7 +173,8 @@ def export_model(export_dir, params, model_name, model_config=None,
     export_dir = _fs_path(export_dir)
     os.makedirs(export_dir, exist_ok=True)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(export_dir, _PARAMS_DIR), params, force=True)
+    ckptr.save(os.path.join(export_dir, _PARAMS_DIR), _globalize(params),
+               force=True)
     ckptr.wait_until_finished()
     ckptr.close()
     if jax.process_index() == 0:

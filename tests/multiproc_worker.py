@@ -1,0 +1,141 @@
+"""Worker program for the multi-process jax.distributed test harness.
+
+Each test spawns N copies of this script (separate interpreters on
+localhost, rank 0 hosting the coordinator) — the TPU-native equivalent of
+the reference's Spark-Standalone separate-worker-process rig (reference
+``test/README.md:10``, SURVEY §4.3) — and each rank runs one named scenario
+exercising a ``jax.process_count() > 1`` code path:
+
+- ``consensus``:   uneven end-of-data across hosts -> all stop together
+- ``infeed``:      ShardedFeed assembles a global batch from per-process
+                   local shards, including an uneven padded tail
+- ``checkpoint``:  orbax collective save/restore with every host entering
+                   the save (non-chief included)
+
+Usage: python multiproc_worker.py <scenario> <rank> <world> <port> <tmpdir>
+"""
+
+import os
+import sys
+
+
+def _arm_env():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=2")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+
+def scenario_consensus(rank, world, tmpdir):
+    import jax
+
+    from tensorflowonspark_tpu.parallel import collectives, mesh as mesh_mod
+
+    assert jax.process_count() == world, jax.process_count()
+    mesh = mesh_mod.build_mesh()
+    # rank r pretends to have 2 + r steps of data: everyone must stop after
+    # min_r(2 + r) = 2 full steps (the exact cross-host end-of-data barrier
+    # replacing the reference's 90%-of-steps heuristic, mnist_spark.py:58-66)
+    results = []
+    for step in range(2 + world + 1):
+        has_data = step < 2 + rank
+        ok = collectives.end_of_data_consensus(mesh, has_data)
+        results.append(ok)
+        if not ok:
+            break
+    assert results == [True, True, False], (rank, results)
+    print("consensus ok", rank, results)
+
+
+def scenario_infeed(rank, world, tmpdir):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu import manager
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel.infeed import ShardedFeed
+
+    mesh = mesh_mod.build_mesh()
+    global_batch = 8 * world
+    assert mesh_mod.local_batch_size(mesh, global_batch) == 8
+
+    # rank 0 gets 12 rows, other ranks 16: step 1 is full, step 2 has a
+    # padded tail on rank 0, step 3 hits end-of-feed everywhere.
+    n_rows = 12 if rank == 0 else 16
+    rows = [[float(rank * 100 + i)] for i in range(n_rows)]
+    mgr = manager.start(b"mp-infeed-%d" % rank, ["input"])
+    q = mgr.get_queue("input")
+    for r in rows:
+        q.put(r)
+    q.put(None)
+
+    sf = ShardedFeed(DataFeed(mgr), mesh, global_batch, prefetch=2)
+    mask_sums = []
+    batch_sums = []
+    for batch, mask in sf.batches():
+        # global reductions over the multi-process sharded array
+        mask_sums.append(float(jax.jit(jnp.sum)(mask)))
+        batch_sums.append(float(jax.jit(jnp.sum)(batch * mask[:, None])))
+    mgr.shutdown()
+
+    expected_mask = [8.0 * world, 12.0 if world == 2 else float(4 + 8 * (world - 1))]
+    assert mask_sums == expected_mask, (rank, mask_sums, expected_mask)
+    # sum of all real rows across ranks
+    total = sum(sum(float(r * 100 + i) for i in range(12 if r == 0 else 16))
+                for r in range(world))
+    assert abs(sum(batch_sums) - total) < 1e-3, (rank, batch_sums, total)
+    print("infeed ok", rank, mask_sums)
+
+
+def scenario_checkpoint(rank, world, tmpdir):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu import checkpoint as ckpt_mod
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.build_mesh()
+    state = {"w": jax.device_put(jnp.arange(4.0), mesh_mod.replicated(mesh)),
+             "step": jnp.asarray(7)}
+    ckpt_dir = os.path.join(tmpdir, "ckpt")
+    # every host enters the collective save; orbax routes the write to the
+    # primary host (the discipline checkpoint.py documents)
+    mgr = ckpt_mod.CheckpointManager(ckpt_dir, is_chief=(rank == 0))
+    assert mgr.maybe_save(3, state, force=True)
+    mgr.wait_until_finished()
+
+    abstract = {"w": np.zeros(4, np.float32), "step": np.asarray(0)}
+    restored, step = mgr.restore_latest(abstract)
+    assert step == 3, step
+    np.testing.assert_array_equal(np.asarray(restored["w"]), np.arange(4.0))
+    mgr.close()
+    print("checkpoint ok", rank)
+
+
+SCENARIOS = {
+    "consensus": scenario_consensus,
+    "infeed": scenario_infeed,
+    "checkpoint": scenario_checkpoint,
+}
+
+
+def main():
+    scenario, rank, world, port, tmpdir = sys.argv[1:6]
+    rank, world = int(rank), int(world)
+    _arm_env()
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address="127.0.0.1:{}".format(port),
+        num_processes=world, process_id=rank)
+    assert jax.process_count() == world
+    SCENARIOS[scenario](rank, world, tmpdir)
+
+
+if __name__ == "__main__":
+    main()

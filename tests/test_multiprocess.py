@@ -1,0 +1,72 @@
+"""Multi-process jax.distributed tests (SURVEY §4.3; r1 VERDICT Missing #2).
+
+Each test spawns N separate interpreters running
+``tests/multiproc_worker.py`` with ``jax.distributed.initialize`` against a
+localhost coordinator, so the ``process_count() > 1`` branches of
+``collectives.py`` / ``mesh.py`` / ``infeed.py`` / ``checkpoint.py``
+actually execute (the in-process 8-device mesh can't reach them).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "multiproc_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_world(scenario, tmpdir, world=2, timeout=180):
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_REPO, env.get("PYTHONPATH", "")) if p)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, scenario, str(rank), str(world),
+             str(port), str(tmpdir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for rank in range(world)
+    ]
+    outs = []
+    failed = False
+    for proc in procs:
+        try:
+            out, _ = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, _ = proc.communicate()
+            failed = True
+        outs.append(out.decode("utf-8", "replace"))
+        failed = failed or proc.returncode != 0
+    if failed:
+        raise AssertionError(
+            "scenario {!r} failed:\n{}".format(
+                scenario, "\n---- rank ----\n".join(outs)))
+    return outs
+
+
+@pytest.mark.slow
+class TestMultiProcess:
+    def test_end_of_data_consensus_uneven_feeds(self, tmp_path):
+        outs = _run_world("consensus", tmp_path)
+        assert all("consensus ok" in o for o in outs)
+
+    def test_sharded_feed_global_batch_assembly(self, tmp_path):
+        outs = _run_world("infeed", tmp_path)
+        assert all("infeed ok" in o for o in outs)
+
+    def test_orbax_collective_save_restore(self, tmp_path):
+        outs = _run_world("checkpoint", tmp_path)
+        assert all("checkpoint ok" in o for o in outs)

@@ -66,7 +66,7 @@ def main_fun(args, ctx):
         state, step = ckpt.restore_latest(
             jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-                jax.device_get(trainer.state)))
+                trainer.state))
         if state is not None:
             trainer.state = jax.device_put(state,
                                            mesh_mod.replicated(mesh))
@@ -91,7 +91,7 @@ def main_fun(args, ctx):
             loss, aux = trainer.step(batch, mask)
             step_count += 1
             if ckpt:
-                ckpt.maybe_save(step_count, jax.device_get(trainer.state))
+                ckpt.maybe_save(step_count, trainer.state)
             if args.max_steps and step_count >= args.max_steps:
                 break
         if args.max_steps and step_count >= args.max_steps:
@@ -100,7 +100,7 @@ def main_fun(args, ctx):
     trainer.history.on_train_end(loss)
     stats = trainer.history.log_stats(loss=float(loss))
     if ckpt:
-        ckpt.maybe_save(step_count, jax.device_get(trainer.state), force=True)
+        ckpt.maybe_save(step_count, trainer.state, force=True)
         ckpt.wait_until_finished()
         ckpt.close()
     if args.export_dir and checkpoint.should_export(ctx):

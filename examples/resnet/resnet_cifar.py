@@ -131,7 +131,7 @@ def main_fun(args, ctx):
                 prof.on_step_end()
             step += 1
             if ckpt:
-                ckpt.maybe_save(step, jax.device_get(trainer.state))
+                ckpt.maybe_save(step, trainer.state)
             if step >= total_steps:
                 break
 
@@ -141,7 +141,7 @@ def main_fun(args, ctx):
     stats = trainer.history.log_stats(
         loss=float(loss), accuracy=float(aux["accuracy"]))
     if ckpt:
-        ckpt.maybe_save(step, jax.device_get(trainer.state), force=True)
+        ckpt.maybe_save(step, trainer.state, force=True)
         ckpt.wait_until_finished()
         ckpt.close()
     if args.export_dir and checkpoint.should_export(ctx):

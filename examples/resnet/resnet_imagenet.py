@@ -1,0 +1,201 @@
+"""ResNet-50 v1.5 / ImageNet distributed training (reference
+``examples/resnet/resnet_imagenet_main.py``).
+
+The BASELINE.md second headline workload: ResNet-50 with ImageNet scale
+constants (1,281,167 train images, 90 epochs, batch 256 — reference
+``imagenet_preprocessing.py:46-49``, ``resnet_imagenet_main.py:271``),
+piecewise LR decay with linear warmup (reference
+``resnet_imagenet_main.py:37-71``), label smoothing + L2 weight decay
+(reference ``resnet_imagenet_main.py:98-100,182-187`` fp16 analog is bf16
+here), synthetic-data mode for benchmarking (reference
+``common.py:315-363``), TimeHistory/MFU stats, periodic checkpoints, and
+the FILES-mode cluster lifecycle.
+
+Run (CPU mesh; tiny smoke):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/resnet/resnet_imagenet.py --cluster_size 2 \
+        --use_synthetic_data --train_steps 2 --batch_size 16 --image_size 64
+
+Run (one v5e chip, synthetic benchmark):
+    python examples/resnet/resnet_imagenet.py --cluster_size 1 \
+        --use_synthetic_data --train_steps 100 --batch_size 128
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+NUM_CLASSES = 1001      # reference uses 1001 (background class), resnet_model
+NUM_IMAGES = 1281167    # reference imagenet_preprocessing.py:46-49
+DEFAULT_IMAGE_SIZE = 224
+
+# Reference LR schedule: 0.1 * batch/256 base, x0.1 at epochs 30, 60, 80,
+# 5-epoch linear warmup (resnet_imagenet_main.py:37-71).
+LR_BOUNDARY_EPOCHS = (30, 60, 80)
+LR_DECAY = 0.1
+WARMUP_EPOCHS = 5
+
+
+def synthetic_imagenet(n, image_size, seed=13):
+    """Learnable synthetic stand-in (reference synthetic input_fn,
+    ``common.py:315-363``): class templates + noise."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    few_classes = min(NUM_CLASSES, 32)  # keep the template table small
+    templates = rng.random((few_classes, image_size, image_size, 3)).astype("f")
+    labels = rng.integers(0, few_classes, (n,))
+    noise = rng.normal(0, 0.1, (n, image_size, image_size, 3)).astype("f")
+    return (templates[labels] + noise).astype("float32"), labels.astype("int32")
+
+
+def main_fun(args, ctx):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import resnet as resnet_mod
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    ctx.initialize_distributed()
+    mesh = mesh_mod.build_mesh()
+    size = args.image_size
+
+    images, labels = synthetic_imagenet(args.synthetic_examples, size)
+    shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
+    images, labels = images[shard], labels[shard]
+
+    model = resnet_mod.build_resnet50(num_classes=NUM_CLASSES,
+                                      dtype=args.dtype)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, size, size, 3)), train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    steps_per_epoch = max(NUM_IMAGES // args.batch_size, 1)
+    total_steps = args.train_steps or steps_per_epoch * args.train_epochs
+    base_lr = args.base_lr * args.batch_size / 256.0
+    warmup_steps = min(WARMUP_EPOCHS * steps_per_epoch,
+                       max(total_steps // 10, 1))
+    boundaries_and_scales = {
+        e * steps_per_epoch: LR_DECAY
+        for e in LR_BOUNDARY_EPOCHS if e * steps_per_epoch < total_steps}
+    schedule = optax.join_schedules(
+        [optax.linear_schedule(0.0, base_lr, warmup_steps),
+         optax.piecewise_constant_schedule(base_lr, boundaries_and_scales)],
+        [warmup_steps])
+    optimizer = optax.sgd(schedule, momentum=0.9)
+
+    trainer = train_mod.Trainer(
+        resnet_mod.loss_fn(model, weight_decay=args.weight_decay,
+                           label_smoothing=args.label_smoothing),
+        params, optimizer, mesh=mesh, extra_state=batch_stats,
+        compute_dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
+        batch_size=args.batch_size, log_steps=args.log_steps)
+
+    ckpt = None
+    if args.model_dir:
+        ckpt = checkpoint.CheckpointManager(
+            ctx.absolute_path(args.model_dir),
+            save_interval_steps=args.save_interval)
+
+    prof = None
+    if args.profile_steps:
+        from tensorflowonspark_tpu import profiler
+
+        prof = profiler.StepProfiler(
+            args.profile_dir or "profile_logs", args.profile_steps)
+
+    local_bs = mesh_mod.local_batch_size(mesh, args.batch_size)
+    sharding = mesh_mod.batch_sharding(mesh)
+    rng = np.random.default_rng(jax.process_index())
+    mask_np = np.ones((local_bs,), np.float32)
+    step = 0
+    loss = aux = None
+    while step < total_steps:
+        order = rng.permutation(len(labels))
+        for s in range(max(len(labels) // local_bs, 1)):
+            idx = order[s * local_bs:(s + 1) * local_bs]
+            if len(idx) < local_bs:
+                break
+            batch = {
+                "image": jax.make_array_from_process_local_data(
+                    sharding, images[idx]),
+                "label": jax.make_array_from_process_local_data(
+                    sharding, labels[idx]),
+            }
+            mask = jax.make_array_from_process_local_data(sharding, mask_np)
+            if prof:
+                prof.on_step_begin()
+            loss, aux = trainer.step(batch, mask)
+            if prof:
+                prof.on_step_end()
+            step += 1
+            if ckpt:
+                ckpt.maybe_save(step, trainer.state)
+            if step >= total_steps:
+                break
+
+    if prof:
+        prof.stop()
+    trainer.history.on_train_end(loss)
+    stats = trainer.history.log_stats(
+        loss=float(loss), accuracy=float(aux["accuracy"]))
+    if ckpt:
+        ckpt.maybe_save(step, trainer.state, force=True)
+        ckpt.wait_until_finished()
+        ckpt.close()
+    if args.export_dir and checkpoint.should_export(ctx):
+        checkpoint.export_model(
+            ctx.absolute_path(args.export_dir),
+            jax.device_get(trainer.state.params), "resnet50",
+            model_config={"num_classes": NUM_CLASSES, "dtype": args.dtype},
+            input_signature={"image": [None, size, size, 3]})
+    return stats
+
+
+def main(argv=None):
+    from tensorflowonspark_tpu import backend, cluster, device_info
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cluster_size", type=int, default=1)
+    parser.add_argument("--batch_size", type=int, default=256,
+                        help="global batch (reference default 256)")
+    parser.add_argument("--train_epochs", type=int, default=90,
+                        help="reference default 90 epochs")
+    parser.add_argument("--train_steps", type=int, default=None,
+                        help="overrides train_epochs when set")
+    parser.add_argument("--image_size", type=int, default=DEFAULT_IMAGE_SIZE)
+    parser.add_argument("--base_lr", type=float, default=0.1)
+    parser.add_argument("--weight_decay", type=float, default=1e-4)
+    parser.add_argument("--label_smoothing", type=float, default=0.1,
+                        help="reference resnet_imagenet_main.py:98-100")
+    parser.add_argument("--dtype", default="bfloat16",
+                        choices=["float32", "bfloat16"])
+    parser.add_argument("--use_synthetic_data", action="store_true")
+    parser.add_argument("--synthetic_examples", type=int, default=1024)
+    parser.add_argument("--model_dir", default=None)
+    parser.add_argument("--export_dir", default=None)
+    parser.add_argument("--save_interval", type=int, default=1000)
+    parser.add_argument("--log_steps", type=int, default=20)
+    parser.add_argument("--profile_steps", default=None)
+    parser.add_argument("--profile_dir", default=None)
+    args, rem = parser.parse_known_args(argv)
+    args.remaining_argv = rem
+
+    b = backend.LocalBackend(args.cluster_size)
+    try:
+        c = cluster.run(b, main_fun, args, num_executors=args.cluster_size,
+                        input_mode=cluster.InputMode.FILES,
+                        executor_env=device_info.tpu_env())
+        c.shutdown(grace_secs=2)
+    finally:
+        b.stop()
+
+
+if __name__ == "__main__":
+    main()

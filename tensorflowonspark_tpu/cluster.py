@@ -256,7 +256,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         tensorboard=False, input_mode=InputMode.FILES, log_dir=None,
         master_node=None, reservation_timeout=600,
         queues=("input", "output", "error"), eval_node=False,
-        release_port=True, profiler=False):
+        release_port=True, profiler=False, executor_env=None):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -273,6 +273,10 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         chief, reference ``TFCluster.py:225,257-258``).
       eval_node: dedicate one node as ``evaluator`` (reference ``TFCluster.py:228``).
       input_mode: :class:`InputMode`.
+      executor_env: env vars every node applies BEFORE any jax/TPU
+        initialization — TPU/XLA perf knobs travel here (build with
+        :func:`~tensorflowonspark_tpu.device_info.tpu_env`; the analog of the
+        reference's GPU-thread tuning, reference ``common.py:143-166``).
     """
     if hasattr(cluster_backend, "parallelize"):  # raw SparkContext
         cluster_backend = backend_mod.SparkBackend(cluster_backend)
@@ -312,6 +316,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         "authkey": uuid.uuid4().bytes.hex(),
         "reservation_timeout": reservation_timeout,
         "input_mode": input_mode,
+        "executor_env": dict(executor_env or {}),
     }
 
     # Launch the start job in the background (reference daemon thread +

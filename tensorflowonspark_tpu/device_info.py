@@ -83,6 +83,40 @@ def pin_chips(worker_index, chips_per_worker, total_chips=4):
     return chips
 
 
+def tpu_env(libtpu_init_args=(), xla_flags=(), base=None, **env_vars):
+    """Compose the TPU/XLA tuning environment for executor processes — the
+    analog of the reference's GPU perf knobs (``TF_GPU_THREAD_MODE`` etc.,
+    reference ``common.py:143-166``); pass the result as ``cluster.run(...,
+    executor_env=...)`` so every node applies it BEFORE its first jax import
+    (libtpu reads these only at client creation).
+
+    Args:
+      libtpu_init_args: iterable of ``--flag=value`` strings appended to
+        ``LIBTPU_INIT_ARGS`` (libtpu runtime flags, e.g.
+        ``--xla_tpu_enable_data_parallel_all_reduce_opt=true``).
+      xla_flags: iterable of ``--xla_...`` strings appended to ``XLA_FLAGS``
+        (compiler flags, e.g. ``--xla_tpu_spmd_threshold_for_allgather_cse=8``).
+      base: dict to extend; the node later merges the result over its own
+        inherited environment.
+      **env_vars: extra plain variables (e.g.
+        ``JAX_ENABLE_ASYNC_CHECKPOINTING="1"``).
+
+    Returns a plain env dict suitable for ``executor_env``.
+    """
+    env = dict(base or {})
+
+    def _append(key, flags):
+        flags = [f for f in flags if f]
+        if flags:
+            prior = env.get(key, "")
+            env[key] = (prior + " " + " ".join(flags)).strip()
+
+    _append("LIBTPU_INIT_ARGS", libtpu_init_args)
+    _append("XLA_FLAGS", xla_flags)
+    env.update({k: str(v) for k, v in env_vars.items()})
+    return env
+
+
 def wait_for_devices(min_devices=1, timeout=90):
     """Block until the TPU runtime exposes at least ``min_devices`` devices.
 

@@ -206,6 +206,12 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         )
         logger.info("executor_id=%d assigned role %s:%d", executor_id, job_name, task_index)
 
+        # Apply cluster-level env (TPU/XLA perf knobs, device_info.tpu_env)
+        # FIRST: libtpu/XLA read these only when the jax client is created,
+        # and everything below (manager fork, user fn) inherits them.
+        if cluster_meta.get("executor_env"):
+            os.environ.update(cluster_meta["executor_env"])
+
         # Stale-node detection: if this working dir already hosts a live node
         # from another cluster instance, fail loudly so the scheduler retries
         # elsewhere (reference TFSparkNode.py:166-172).

@@ -129,3 +129,80 @@ def test_master_node_and_roles(local_backend):
     # chief is always jax process 0 (stable coordinator assignment)
     assert c.cluster_info[0]["job_name"] == "chief"
     c.shutdown()
+
+
+def test_executor_env_reaches_nodes(local_backend):
+    """TPU/XLA perf knobs (device_info.tpu_env) must land in every node's
+    process env before user code runs (reference GPU-thread tuning analog,
+    ``common.py:143-166``)."""
+    from tensorflowonspark_tpu import device_info
+
+    env = device_info.tpu_env(
+        libtpu_init_args=["--xla_tpu_enable_data_parallel_all_reduce_opt=true"],
+        xla_flags=["--xla_dump_disable_metadata"],
+        TFOS_TEST_KNOB="42")
+    assert env["LIBTPU_INIT_ARGS"] == \
+        "--xla_tpu_enable_data_parallel_all_reduce_opt=true"
+    assert "--xla_dump_disable_metadata" in env["XLA_FLAGS"]
+
+    def map_fun(args, ctx):
+        with open("env.txt", "w") as f:
+            f.write("{}|{}".format(os.environ.get("LIBTPU_INIT_ARGS", ""),
+                                   os.environ.get("TFOS_TEST_KNOB", "")))
+
+    c = cluster.run(local_backend, map_fun, tf_args=[], num_executors=2,
+                    input_mode=InputMode.FILES, executor_env=env)
+    c.shutdown()
+    for i in range(2):
+        path = os.path.join(local_backend.workdir_root,
+                            "executor-{}".format(i), "env.txt")
+        with open(path) as f:
+            libtpu, knob = f.read().split("|")
+        assert "--xla_tpu_enable_data_parallel_all_reduce_opt=true" in libtpu
+        assert knob == "42"
+
+
+def test_tensorboard_lifecycle(local_backend, tmp_path, monkeypatch):
+    """Framework-managed TensorBoard: launched on the first worker, port in
+    the roster, URL exposed, killed at shutdown (reference
+    ``TFSparkNode.py:199-225,522-528`` — untested there; tested here)."""
+    import stat
+    import time
+
+    # stub `tensorboard` on PATH: a script that parks until killed
+    stub = tmp_path / "tensorboard"
+    stub.write_text("import time\ntime.sleep(600)\n")
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", str(tmp_path) + os.pathsep + os.environ["PATH"])
+
+    def map_fun(args, ctx):
+        pass
+
+    c = cluster.run(local_backend, map_fun, tf_args=[], num_executors=2,
+                    input_mode=InputMode.FILES, tensorboard=True,
+                    log_dir=str(tmp_path / "tb_logs"),
+                    executor_env={"PATH": str(tmp_path) + os.pathsep
+                                  + os.environ["PATH"]})
+    tb_nodes = [n for n in c.cluster_info if n.get("tb_pid")]
+    assert len(tb_nodes) == 1, c.cluster_info
+    node_meta = tb_nodes[0]
+    assert node_meta["tb_port"] > 0
+    assert c.tensorboard_url() == "http://{}:{}".format(
+        node_meta["host"], node_meta["tb_port"])
+    pid = node_meta["tb_pid"]
+    os.kill(pid, 0)  # alive while the cluster runs
+
+    c.shutdown()
+    # dead (or zombie awaiting reap) after shutdown's kill
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        try:
+            with open("/proc/{}/stat".format(pid)) as f:
+                state = f.read().split(")")[-1].split()[0]
+            if state == "Z":
+                break
+        except OSError:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("tensorboard stub pid {} still alive".format(pid))

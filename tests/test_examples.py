@@ -101,3 +101,11 @@ def test_mnist_pipeline_end_to_end():
                       ["--cluster_size", "2", "--epochs", "1",
                        "--batch_size", "256"], timeout=560)
     assert "pipeline accuracy" in out
+
+
+def test_resnet_imagenet_synthetic():
+    out = run_example("resnet/resnet_imagenet.py",
+                      ["--cluster_size", "2", "--use_synthetic_data",
+                       "--train_steps", "2", "--batch_size", "16",
+                       "--image_size", "64", "--synthetic_examples", "64"])
+    assert "train stats" in out

@@ -53,26 +53,37 @@ class TPUCluster(object):
 
     # -- data plane -------------------------------------------------------
 
-    def train(self, data, num_epochs=1, feed_timeout=600, qname="input"):
+    def train(self, data, num_epochs=1, feed_timeout=600, qname="input",
+              chunk_size=1024):
         """Feed partitioned data for training (InputMode.SPARK only;
         reference ``TFCluster.py:61-92``).
 
         ``data`` may be:
         - a list of partitions (built-in backend) or an RDD (Spark backend);
-          epochs are fed by repeating the partition list (reference
+          epochs repeat **executor-side** — each feed task replays its
+          partition's packed chunks ``num_epochs`` times from an
+          executor-local cache, so the driver ships every row exactly once
+          (the reference re-shipped each epoch via
           ``sc.union([rdd]*num_epochs)``, ``TFCluster.py:88-91``);
         - a Spark Streaming DStream: every micro-batch RDD is fed as its own
           feed job until STOP (reference DStream branch, ``TFCluster.py:81-83``;
           pair with ``shutdown(ssc=...)``);
         - an *iterator/generator of partitions* for streaming without Spark:
           fed until exhausted or a STOP is requested.
+
+        ``chunk_size`` governs feed amortization: rows travel in columnar
+        chunks of this many rows (see ``node.train``).
         """
         logger.info("Feeding training data")
         assert self.input_mode == InputMode.SPARK, \
             "train() feeding requires InputMode.SPARK"
         assert num_epochs >= 0
-        fn = node.train(self.cluster_info, self.cluster_meta, qname, feed_timeout)
+        fn = node.train(self.cluster_info, self.cluster_meta, qname,
+                        feed_timeout, chunk_size, max(num_epochs, 1))
         if hasattr(data, "foreachRDD"):  # Spark Streaming DStream
+            # Streaming has no epochs: feed each micro-batch once.
+            fn = node.train(self.cluster_info, self.cluster_meta, qname,
+                            feed_timeout, chunk_size)
             cluster = self
 
             def _feed_batch(rdd):
@@ -85,28 +96,28 @@ class TPUCluster(object):
 
             data.foreachRDD(_feed_batch)
         elif hasattr(data, "__next__"):  # streaming source: unbounded partitions
+            # Streaming has no epochs: feed each partition once.
+            fn = node.train(self.cluster_info, self.cluster_meta, qname,
+                            feed_timeout, chunk_size)
             for part in data:
                 if self.server.done:
                     logger.info("STOP requested; ending streaming feed")
                     break
                 self.backend.foreach_partition([part], fn)
         elif hasattr(data, "foreachPartition"):  # Spark RDD
-            rdd = data
-            if num_epochs > 1:
-                rdd = self.backend.sc.union([rdd] * num_epochs)
-            self.backend.foreach_partition(rdd, fn)
+            self.backend.foreach_partition(data, fn)
         else:
-            partitions = list(data) * max(num_epochs, 1)
-            self.backend.foreach_partition(partitions, fn)
+            self.backend.foreach_partition(list(data), fn)
 
-    def inference(self, data, qname="input"):
+    def inference(self, data, qname="input", chunk_size=1024):
         """Feed data for inference, returning per-item results (reference
         ``TFCluster.py:94-113``).  Results preserve partition order; the
         1:1 item/result contract is enforced by the node feeder."""
         logger.info("Feeding inference data")
         assert self.input_mode == InputMode.SPARK, \
             "inference() feeding requires InputMode.SPARK"
-        fn = node.inference(self.cluster_info, self.cluster_meta, qname)
+        fn = node.inference(self.cluster_info, self.cluster_meta, qname,
+                            chunk_size=chunk_size)
         results = self.backend.map_partitions(data, fn)
         if hasattr(results, "collect"):  # Spark path returns an RDD-like
             return results

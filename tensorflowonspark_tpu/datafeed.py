@@ -23,6 +23,27 @@ logger = logging.getLogger(__name__)
 _INTERRUPTED = object()  # internal next_batch abort marker (see interrupt())
 
 
+def _rows_to_fields(rows):
+    """Convert a list of rows into per-field arrays: ``(fields, tuple_rows)``
+    (the degraded path for object chunks; columnar chunks skip this).
+    Only tuples are rows-of-fields — see ``marker.pack_columnar``."""
+    first = rows[0]
+    if isinstance(first, tuple):
+        arity = len(first)
+        for r in rows:
+            if not isinstance(r, tuple) or len(r) != arity:
+                # Truncating to the first row's arity would silently drop
+                # fields of wider rows — wrong training data; fail loudly.
+                raise ValueError(
+                    "inconsistent row arity in feed chunk: expected {}-field "
+                    "tuples, got {!r}".format(arity, type(r).__name__
+                                              if not isinstance(r, tuple)
+                                              else len(r)))
+        return (tuple(np.asarray([r[f] for r in rows])
+                      for f in range(arity)), True)
+    return (np.asarray(rows),), False
+
+
 def absolute_path(ctx, path):
     """Convert a user path to an absolute path on shared storage.
 
@@ -85,8 +106,10 @@ class DataFeed(object):
             [tensor for _, tensor in sorted(input_mapping.items())]
             if input_mapping is not None else None
         )
-        # Unpacked-but-unconsumed items from the last Chunk (feeders send
-        # chunks to amortize the per-element IPC hop; see marker.Chunk).
+        # Unpacked-but-unconsumed rows from the last chunk (feeders send
+        # chunks to amortize the per-element IPC hop; see marker.Chunk /
+        # marker.ColChunk).  ``_buffer`` is either a list of items or a
+        # ColChunk (columnar rows); ``_buffer_idx`` indexes rows in both.
         # The chunk's task_done is DEFERRED until its last item is handed
         # out (_chunk_q holds the pending ack): a consumer crashing
         # mid-chunk must leave the queue un-joined so the feeder's
@@ -116,8 +139,8 @@ class DataFeed(object):
                    else {tensor: [] for tensor in self.input_tensors})
         count = 0
         while count < batch_size:
-            if self._buffer_idx < len(self._buffer):
-                item = self._buffer[self._buffer_idx]
+            if self._buffer_idx < self._buflen():
+                item = self._bufrow(self._buffer_idx)
                 self._buffer_idx += 1
                 from_queue = False
             else:
@@ -129,17 +152,15 @@ class DataFeed(object):
                 if isinstance(item, marker.ShmChunk):
                     # Payload took the native shm-ring fast path; the token
                     # preserves ordering/join semantics (see marker.ShmChunk).
-                    self._buffer = self._ring_read(item)
+                    item = self._ring_read(item)
+                if isinstance(item, (marker.Chunk, marker.ColChunk)):
+                    # Buffer the chunk (item list or columnar); ack deferred
+                    # (see ctor).
+                    self._buffer = (item.items if isinstance(item, marker.Chunk)
+                                    else item)
                     self._buffer_idx = 0
                     self._chunk_q = queue
-                    if not self._buffer:
-                        self._ack_chunk()
-                    continue
-                if isinstance(item, marker.Chunk):
-                    # Unpack into the local buffer; ack deferred (see ctor).
-                    self._buffer, self._buffer_idx = item.items, 0
-                    self._chunk_q = queue
-                    if not item.items:
+                    if not self._buflen():
                         self._ack_chunk()
                     continue
             if item is None:
@@ -166,13 +187,23 @@ class DataFeed(object):
                 count += 1
                 if from_queue:
                     queue.task_done()
-                elif self._buffer_idx >= len(self._buffer):
+                elif self._buffer_idx >= self._buflen():
                     # Ack only after the chunk's last item is safely batched:
                     # a crash on a malformed item above must leave the queue
                     # un-joined so the feeder's error-poll fires (see ctor).
                     self._ack_chunk()
         logger.debug("next_batch: returning %d items", count)
         return tensors
+
+    def _buflen(self):
+        """Row count of the pending chunk buffer (item list or columnar)."""
+        buf = self._buffer
+        return buf.count if isinstance(buf, marker.ColChunk) else len(buf)
+
+    def _bufrow(self, i):
+        """Row ``i`` of the pending chunk buffer."""
+        buf = self._buffer
+        return buf.row(i) if isinstance(buf, marker.ColChunk) else buf[i]
 
     def _get_interruptible(self, queue):
         """Blocking get that aborts (returning ``_INTERRUPTED``) once
@@ -199,7 +230,10 @@ class DataFeed(object):
             self._chunk_q = None
 
     def _ring_read(self, token, timeout_secs=600):
-        """Pop one chunk payload from the shm ring named by the token."""
+        """Pop one chunk payload from the shm ring named by the token;
+        returns the chunk object (:class:`~tensorflowonspark_tpu.marker.Chunk`
+        or :class:`~tensorflowonspark_tpu.marker.ColChunk`; legacy payloads
+        may be bare item lists, returned wrapped in a Chunk)."""
         import pickle
 
         from tensorflowonspark_tpu import shmring
@@ -209,36 +243,134 @@ class DataFeed(object):
             raise RuntimeError(
                 "feeder sent a shm-ring chunk but ring {} cannot be attached "
                 "in the consumer process".format(token.ring_name))
-        items = pickle.loads(ring.get_bytes(timeout_secs))
-        if len(items) != token.count:
+        obj = pickle.loads(ring.get_bytes(timeout_secs))
+        if isinstance(obj, list):
+            obj = marker.Chunk(obj)
+        n = obj.count if isinstance(obj, marker.ColChunk) else len(obj.items)
+        if n != token.count:
             # Token/record desync would silently deliver wrong training data;
             # must survive python -O, so not an assert.
             raise RuntimeError(
                 "shm ring {} desync: token promised {} items, record has "
-                "{}".format(token.ring_name, token.count, len(items)))
-        return items
+                "{}".format(token.ring_name, token.count, n))
+        return obj
 
     def next_batch_arrays(self, batch_size, dtypes=None):
         """TPU-first variant: assemble the batch directly into numpy arrays.
 
-        One columnar ``np.asarray`` per tensor instead of a Python list the
-        user must re-stack element-wise; pairs with
+        Columnar end to end: feeders ship
+        :class:`~tensorflowonspark_tpu.marker.ColChunk` blocks (a few
+        contiguous ndarrays), and this method concatenates column *slices* —
+        no per-row Python objects ever exist on this path.  Object chunks /
+        loose items degrade gracefully to per-row ``np.asarray``.  Pairs with
         ``parallel.infeed.ShardedFeed`` for a single per-host device transfer.
-        Returns ``(arrays, count)`` where arrays is an ndarray (no
-        input_mapping) or dict of ndarrays; ``count`` is the number of real
-        rows (may be < batch_size at end of feed).
+
+        Returns ``(arrays, count)`` where ``count`` is the number of real
+        rows (may be < batch_size at end of feed) and ``arrays`` is:
+
+        - a dict ``{tensor_name: ndarray}`` when ``input_mapping`` was given
+          (row fields map positionally to the sorted column order, exactly
+          like :meth:`next_batch`);
+        - a tuple of per-field ndarrays when rows are tuples;
+        - a single ndarray when rows are single values.
+
+        ``dtypes``: optional cast — a dict keyed by tensor name (with
+        input_mapping), a sequence matching the field count (tuple rows), or
+        a single dtype (single-value rows).
         """
-        batch = self.next_batch(batch_size)
-        if self.input_tensors is None:
-            count = len(batch)
-            arr = np.asarray(batch, dtype=dtypes) if count else np.empty((0,))
-            return arr, count
-        count = len(next(iter(batch.values()))) if batch else 0
-        arrays = {
-            tensor: np.asarray(col, dtype=None if dtypes is None else dtypes.get(tensor))
-            for tensor, col in batch.items()
-        }
-        return arrays, count
+        queue = self.mgr.get_queue(self.qname_in)
+        parts = []       # per-part tuple of per-field array slices
+        tuple_rows = None
+        count = 0
+        while count < batch_size:
+            buflen = self._buflen()
+            if self._buffer_idx < buflen:
+                take = min(batch_size - count, buflen - self._buffer_idx)
+                i0 = self._buffer_idx
+                buf = self._buffer
+                if isinstance(buf, marker.ColChunk):
+                    fields = tuple(c[i0:i0 + take] for c in buf.columns)
+                    tr = buf.tuple_rows
+                else:
+                    fields, tr = _rows_to_fields(buf[i0:i0 + take])
+                if tuple_rows is None:
+                    tuple_rows = tr
+                elif tuple_rows != tr or (parts and len(parts[-1]) != len(fields)):
+                    raise ValueError(
+                        "inconsistent row structure across feed chunks "
+                        "(tuple_rows {} vs {})".format(tuple_rows, tr))
+                parts.append(fields)
+                count += take
+                self._buffer_idx += take
+                if self._buffer_idx >= buflen:
+                    self._ack_chunk()
+                continue
+            item = self._get_interruptible(queue)
+            if item is _INTERRUPTED:
+                logger.info("next_batch_arrays: interrupted at %d rows", count)
+                break
+            if isinstance(item, marker.ShmChunk):
+                item = self._ring_read(item)
+            if isinstance(item, (marker.Chunk, marker.ColChunk)):
+                self._buffer = (item.items if isinstance(item, marker.Chunk)
+                                else item)
+                self._buffer_idx = 0
+                self._chunk_q = queue
+                if not self._buflen():
+                    self._ack_chunk()
+                continue
+            if item is None:
+                logger.info("next_batch_arrays: end of feed")
+                self.done_feeding = True
+                queue.task_done()
+                break
+            if isinstance(item, marker.EndPartition):
+                queue.task_done()
+                if count > 0:
+                    break
+                continue
+            # A loose (unchunked) item: treat as a one-row part, under the
+            # same structure-consistency contract as the chunk path.
+            fields, tr = _rows_to_fields([item])
+            if tuple_rows is None:
+                tuple_rows = tr
+            elif tuple_rows != tr or (parts and len(parts[-1]) != len(fields)):
+                raise ValueError(
+                    "inconsistent row structure across feed items "
+                    "(tuple_rows {} vs {})".format(tuple_rows, tr))
+            parts.append(fields)
+            count += 1
+            queue.task_done()
+        return self._assemble_columns(parts, tuple_rows, dtypes), count
+
+    def _assemble_columns(self, parts, tuple_rows, dtypes):
+        """Concatenate per-part field slices into final per-field arrays and
+        shape the result per the input_mapping contract."""
+        if not parts:
+            if self.input_tensors is None:
+                return np.empty((0,))
+            return {t: np.empty((0,)) for t in self.input_tensors}
+        arity = len(parts[0])
+
+        def col(f, dtype):
+            arrs = [p[f] for p in parts]
+            out = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+            return out if dtype is None else np.asarray(out, dtype=dtype)
+
+        if self.input_tensors is not None:
+            if arity != len(self.input_tensors):
+                raise ValueError(
+                    "input_mapping names {} tensors but feed rows have {} "
+                    "fields".format(len(self.input_tensors), arity))
+            return {
+                t: col(f, None if dtypes is None else dtypes.get(t))
+                for f, t in enumerate(self.input_tensors)
+            }
+        if tuple_rows:
+            return tuple(
+                col(f, None if dtypes is None else dtypes[f])
+                for f in range(arity))
+        return col(0, dtypes)
 
     def should_stop(self):
         """True once end-of-feed was observed (reference ``TFNode.py:153-155``)."""

@@ -47,3 +47,71 @@ class ShmChunk(Marker):
     def __init__(self, ring_name, count):
         self.ring_name = ring_name
         self.count = count
+
+
+class ColChunk(Marker):
+    """A block of rows stored **columnar**: one contiguous ndarray per field.
+
+    TPU-first: a block of N ``(ndarray, scalar, ...)`` rows pickles as N
+    small objects with per-object overhead and unpickles back into N objects
+    the consumer must re-stack; the same block as a few contiguous ndarrays
+    pickles/unpickles as a handful of memcpys and feeds straight into
+    columnar batch assembly (``DataFeed.next_batch_arrays`` concatenates
+    column slices — no per-row Python objects anywhere on the hot path).
+
+    ``columns``: tuple of ndarrays, all sharing leading dim ``count``.
+    ``tuple_rows``: True when the original rows were tuples/lists of fields
+    (``row(i) == tuple(col[i] for col in columns)``); False when rows were
+    single values (``row(i) == columns[0][i]``).
+    """
+
+    __slots__ = ("columns", "count", "tuple_rows")
+
+    def __init__(self, columns, count, tuple_rows):
+        self.columns = columns
+        self.count = count
+        self.tuple_rows = tuple_rows
+
+    def row(self, i):
+        """Materialize row ``i`` (compat path for the item-list API)."""
+        if self.tuple_rows:
+            return tuple(col[i] for col in self.columns)
+        return self.columns[0][i]
+
+
+def pack_columnar(block):
+    """Pack a list of rows into a :class:`ColChunk`, or return ``None`` when
+    the rows aren't uniformly shaped numeric fields (caller falls back to a
+    plain object :class:`Chunk`).
+
+    **Tuples** are rows-of-fields (each field an ndarray or scalar with a
+    consistent shape/dtype across the block); anything else (list, ndarray,
+    scalar) is a single data value — a ``[1.0, 2.0]`` list row is a length-2
+    vector, not two fields (matching ``DataFeed.next_batch_arrays``'s
+    historical ``np.asarray(items)`` contract).
+    """
+    import numpy as np
+
+    if not block:
+        return None
+    first = block[0]
+    try:
+        if isinstance(first, tuple):
+            arity = len(first)
+            if arity == 0 or any(not isinstance(r, tuple)
+                                 or len(r) != arity for r in block):
+                return None
+            cols = []
+            for f in range(arity):
+                col = np.asarray([row[f] for row in block])
+                if col.dtype == object:
+                    return None
+                cols.append(col)
+            return ColChunk(tuple(cols), len(block), True)
+        col = np.asarray(block)
+        if col.dtype == object:
+            return None
+        return ColChunk((col,), len(block), False)
+    except (ValueError, TypeError):
+        # ragged shapes / mixed types: not columnar-packable
+        return None

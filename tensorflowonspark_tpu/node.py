@@ -434,14 +434,26 @@ def _get_manager(cluster_info, host, executor_id):
 
 
 def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
-          chunk_size=256):
+          chunk_size=1024, num_epochs=1):
     """Feed-job closure: push partition items into this executor's input queue
     (reference ``TFSparkNode.py:371-438``).
 
-    Items travel in :class:`~tensorflowonspark_tpu.marker.Chunk` blocks of
-    ``chunk_size`` so the manager-proxy IPC cost amortizes (the reference's
+    Items travel in **columnar** :class:`~tensorflowonspark_tpu.marker.ColChunk`
+    blocks of ``chunk_size`` (object :class:`~tensorflowonspark_tpu.marker.Chunk`
+    fallback for non-uniform rows) so the manager-proxy IPC cost amortizes and
+    serialization is a few memcpys, not per-row pickling (the reference's
     per-element hops were its feed ceiling, SURVEY §3.2); backpressure is at
-    chunk granularity via the JoinableQueue."""
+    chunk granularity via the JoinableQueue.
+
+    ``num_epochs > 1`` repeats the partition **executor-side**: the feeder
+    caches each packed chunk's serialized bytes on the first pass and re-puts
+    them per epoch, so epochs cost zero driver->executor shipping and zero
+    re-serialization (the reference re-shipped every epoch from the driver
+    via ``sc.union([rdd]*num_epochs)``, reference ``TFCluster.py:88-91``).
+    Epoch order is per-partition (P1 P1 P2 P2 ...) rather than the
+    reference's per-epoch (P1 P2 P1 P2 ...); with per-step batching this is
+    equivalent for training and the driver ships each row exactly once.
+    """
 
     def _train(iterator):
         host = util.get_ip_address()
@@ -456,9 +468,13 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
             count = sum(1 for _ in iterator)
             logger.info("skipped %d items", count)
         else:
-            put = _chunk_putter(queue, cluster_meta, executor_id, qname,
-                                feed_timeout)
-            count = _feed_blocks(iterator, put, chunk_size)
+            putter = _ChunkPutter(queue, cluster_meta, executor_id, qname,
+                                  feed_timeout, cache=(num_epochs > 1))
+            count = _feed_blocks(iterator, putter.put, chunk_size)
+            for _ in range(num_epochs - 1):
+                if mgr.get("state") in ("terminating", "stopped"):
+                    break
+                count += putter.reput_cached()
             # Wait for the consumer to drain the queue, surfacing user-code
             # errors and enforcing feed_timeout (reference TFSparkNode.py:407-418).
             _join_with_error_check(mgr, queue, feed_timeout, "feeding")
@@ -490,33 +506,87 @@ def _feed_blocks(iterator, put, chunk_size):
     return count
 
 
-def _chunk_putter(queue, cluster_meta, executor_id, qname, feed_timeout):
-    """Returns ``put(block)`` sending item blocks the fastest way available:
-    payload through the native shm ring with an ordering token on the queue,
-    or a plain in-queue Chunk when the ring is unavailable / the record is
-    oversized (see :mod:`~tensorflowonspark_tpu.shmring`)."""
-    import pickle
+class _ChunkPutter(object):
+    """Sends item blocks the fastest way available: columnar-packed payload
+    through the native shm ring with an ordering token on the queue, or an
+    in-queue chunk when the ring is unavailable / the record is oversized
+    (see :mod:`~tensorflowonspark_tpu.shmring`).
 
-    from tensorflowonspark_tpu import shmring
+    With ``cache=True`` every block's packed chunk (and its serialized
+    bytes, when the ring path was taken) is retained so
+    :meth:`reput_cached` can replay the whole partition without touching
+    the source rows again — the executor-side epoch repeat.
+    """
 
-    # Attach-only: the node process created the ring at startup (run());
-    # a feed task must never create one, or a recycled Spark worker's exit
-    # would unlink it under the live consumer (see run()).  No ring (e.g. a
-    # custom qname the node didn't pre-create) falls back to plain Chunks.
-    ring = None
-    if shmring.available():
-        ring = shmring.get_ring(
-            shmring.ring_name(cluster_meta["id"], executor_id, qname))
+    def __init__(self, queue, cluster_meta, executor_id, qname, feed_timeout,
+                 cache=False):
+        from tensorflowonspark_tpu import shmring
 
-    def put(block):
-        if ring is not None:
-            data = pickle.dumps(block, protocol=pickle.HIGHEST_PROTOCOL)
-            if ring.put_bytes(data, timeout_secs=feed_timeout):
-                queue.put(marker.ShmChunk(ring.name, len(block)), block=True)
-                return
-        queue.put(marker.Chunk(block), block=True)
+        self._queue = queue
+        self._feed_timeout = feed_timeout
+        self._cache = [] if cache else None
+        # Attach-only: the node process created the ring at startup (run());
+        # a feed task must never create one, or a recycled Spark worker's
+        # exit would unlink it under the live consumer (see run()).  No ring
+        # (e.g. a custom qname the node didn't pre-create) falls back to
+        # in-queue chunks.
+        self._ring = None
+        if shmring.available():
+            self._ring = shmring.get_ring(
+                shmring.ring_name(cluster_meta["id"], executor_id, qname))
 
-    return put
+    def put(self, block):
+        chunk = marker.pack_columnar(block)
+        n = len(block)
+        if chunk is None:
+            chunk = marker.Chunk(block)
+        data = self._send(chunk, n, data=None)
+        if self._cache is not None:
+            # When the ring path was taken, the bytes alone suffice for
+            # replay (holding the chunk too would double the partition's
+            # resident footprint for the whole feed).
+            self._cache.append((None if data is not None else chunk, n, data))
+
+    def reput_cached(self):
+        """Re-send every cached chunk (one epoch); returns the item count."""
+        import pickle
+
+        total = 0
+        for chunk, n, data in self._cache or ():
+            if chunk is None:
+                # Rare fallback: the ring accepted this chunk last epoch but
+                # rejects it now (e.g. ring unlinked mid-run) — reconstruct
+                # the object for the in-queue path.
+                if self._send_bytes(data, n):
+                    total += n
+                    continue
+                chunk = pickle.loads(data)
+            self._send(chunk, n, data)
+            total += n
+        return total
+
+    def _send_bytes(self, data, n):
+        """Ring-path replay of cached bytes; False if the ring refused."""
+        if self._ring is not None and self._ring.put_bytes(
+                data, timeout_secs=self._feed_timeout):
+            self._queue.put(marker.ShmChunk(self._ring.name, n), block=True)
+            return True
+        return False
+
+    def _send(self, chunk, n, data):
+        """Ship one chunk; returns the serialized bytes if the ring path was
+        taken (for the epoch-repeat cache), else None."""
+        import pickle
+
+        if self._ring is not None:
+            if data is None:
+                data = pickle.dumps(chunk, protocol=pickle.HIGHEST_PROTOCOL)
+            if self._ring.put_bytes(data, timeout_secs=self._feed_timeout):
+                self._queue.put(marker.ShmChunk(self._ring.name, n),
+                                block=True)
+                return data
+        self._queue.put(chunk, block=True)
+        return None
 
 
 def _join_with_error_check(mgr, queue, timeout, phase):
@@ -559,7 +629,7 @@ def _join_with_error_check(mgr, queue, timeout, phase):
 
 
 def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
-              feed_timeout=600, chunk_size=256):
+              feed_timeout=600, chunk_size=1024):
     """Inference feed-job closure: push one partition, await exactly one result
     per input item (reference ``TFSparkNode.py:441-502``)."""
 
@@ -569,9 +639,9 @@ def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
         mgr = _get_manager(cluster_info, host, executor_id)
         queue_in = mgr.get_queue(qname_in)
 
-        put = _chunk_putter(queue_in, cluster_meta, executor_id, qname_in,
-                            feed_timeout)
-        count = _feed_blocks(iterator, put, chunk_size)
+        putter = _ChunkPutter(queue_in, cluster_meta, executor_id, qname_in,
+                              feed_timeout)
+        count = _feed_blocks(iterator, putter.put, chunk_size)
         # Signal end-of-partition so DataFeed can align result batches
         # (reference TFSparkNode.py:469, marker.py).
         queue_in.put(marker.EndPartition(), block=True)

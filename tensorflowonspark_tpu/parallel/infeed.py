@@ -37,21 +37,31 @@ class ShardedFeed(object):
       global_batch_size: total batch across all hosts; this host contributes
         ``global_batch_size / process_count`` rows per step.
       preprocess: optional ``fn(items) -> pytree of np.ndarray`` turning a
-        list of queue items into columnar arrays (default: ``np.asarray``).
+        list of queue items into columnar arrays.  This is the *row-list*
+        path (per-item Python objects); prefer ``transform``.
+      transform: optional ``fn(arrays) -> pytree of np.ndarray`` applied to
+        the **columnar** batch from ``DataFeed.next_batch_arrays`` (a tuple
+        of per-field arrays, a dict when the feed has an input_mapping, or a
+        single array) — e.g. reshape ``(N, 784) -> (N, 28, 28, 1)`` and name
+        the fields.  The columnar path never materializes per-row objects;
+        pair with feeders' ColChunk blocks for the full zero-object plane.
       pad_final: when the feed ends mid-batch, pad the final global batch to
         full size and attach a validity mask instead of dropping the tail.
       prefetch: number of batches to assemble ahead on a host thread.
     """
 
     def __init__(self, feed, mesh, global_batch_size, preprocess=None,
-                 pad_final=True, prefetch=2):
+                 transform=None, pad_final=True, prefetch=2):
         import jax
 
+        assert preprocess is None or transform is None, \
+            "pass either preprocess (row-list path) or transform (columnar)"
         self.feed = feed
         self.mesh = mesh
         self.global_batch_size = global_batch_size
         self.local_batch_size = mesh_mod.local_batch_size(mesh, global_batch_size)
-        self.preprocess = preprocess  # None = np.asarray per column/batch
+        self.preprocess = preprocess  # None = columnar next_batch_arrays path
+        self.transform = transform
         self.pad_final = pad_final
         self._prefetch_depth = prefetch
         self._sharding = mesh_mod.batch_sharding(mesh)
@@ -62,20 +72,24 @@ class ShardedFeed(object):
     # -- host-side batch assembly ----------------------------------------
 
     def _next_local(self):
-        """Assemble this host's local rows; returns (arrays, count) or None
-        when no usable rows remain."""
+        """Assemble this host's local batch as final columnar arrays;
+        returns (arrays, count) or None when no usable rows remain."""
         if self.preprocess is not None:
-            # user preprocess consumes the raw item lists
+            # row-list path: user preprocess consumes the raw item lists
             items = self.feed.next_batch(self.local_batch_size)
             if isinstance(items, dict):
                 count = len(next(iter(items.values()))) if items else 0
             else:
                 count = len(items)
-            arrays = items
+            if count == 0:
+                return None
+            arrays = self.preprocess(items)
         else:
             arrays, count = self.feed.next_batch_arrays(self.local_batch_size)
-        if count == 0:
-            return None
+            if count == 0:
+                return None
+            if self.transform is not None:
+                arrays = self.transform(arrays)
         if count < self.local_batch_size and not self.pad_final:
             # partial tail with padding disabled: drop it (documented)
             logger.info("dropping %d-row partial tail (pad_final=False)", count)
@@ -95,8 +109,7 @@ class ShardedFeed(object):
                 col = np.pad(col, pad)
             return col
 
-        local = self.preprocess(arrays) if self.preprocess is not None else arrays
-        local = jax.tree_util.tree_map(to_padded, local)
+        local = jax.tree_util.tree_map(to_padded, arrays)
         mask = np.zeros((self.local_batch_size,), dtype=np.float32)
         mask[:count] = 1.0
 

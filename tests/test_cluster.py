@@ -206,3 +206,39 @@ def test_tensorboard_lifecycle(local_backend, tmp_path, monkeypatch):
         time.sleep(0.2)
     else:
         raise AssertionError("tensorboard stub pid {} still alive".format(pid))
+
+
+def test_columnar_feed_epochs_and_chunk_size(local_backend):
+    """Columnar end to end through the cluster: ndarray-tuple rows arrive as
+    ColChunk blocks, the worker consumes them with next_batch_arrays, epochs
+    replay executor-side, and chunk_size is plumbed from cluster.train."""
+    import numpy as np
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed()
+        total_rows = 0
+        label_sum = 0
+        while not feed.should_stop():
+            arrays, count = feed.next_batch_arrays(6)
+            if count:
+                x, y = arrays
+                assert x.shape[1:] == (4,), x.shape
+                total_rows += count
+                label_sum += int(y.sum())
+        with open("colstats.txt", "w") as f:
+            f.write("{}:{}".format(total_rows, label_sum))
+
+    rows = [(np.full(4, i, np.float32), i) for i in range(20)]
+    c = cluster.run(local_backend, map_fun, tf_args=[], num_executors=2,
+                    input_mode=InputMode.SPARK)
+    c.train(backend.partition(rows, 4), num_epochs=3, chunk_size=4)
+    c.shutdown()
+    rows_seen = labels = 0
+    for i in range(2):
+        with open(os.path.join(local_backend.workdir_root,
+                               "executor-{}".format(i), "colstats.txt")) as f:
+            r, s = f.read().split(":")
+            rows_seen += int(r)
+            labels += int(s)
+    assert rows_seen == 20 * 3
+    assert labels == sum(range(20)) * 3

@@ -146,3 +146,106 @@ class TestAbsolutePath:
     def test_absolute_on_hdfs_fs(self):
         ctx = self._ctx("hdfs://nn:8020")
         assert absolute_path(ctx, "/data/x") == "/data/x"
+
+
+class TestColumnarPlane:
+    """The columnar data plane: ColChunk packing at the feeder, zero-object
+    consumption in next_batch_arrays, row compat in next_batch."""
+
+    def test_pack_columnar_tuple_rows(self):
+        import numpy as np
+
+        block = [(np.arange(4, dtype=np.float32) + i, i) for i in range(6)]
+        ck = marker.pack_columnar(block)
+        assert isinstance(ck, marker.ColChunk)
+        assert ck.count == 6 and ck.tuple_rows
+        assert ck.columns[0].shape == (6, 4)
+        assert ck.columns[1].tolist() == list(range(6))
+        img, lab = ck.row(2)
+        assert lab == 2 and img.tolist() == [2.0, 3.0, 4.0, 5.0]
+
+    def test_pack_columnar_vector_list_rows(self):
+        # A [1.0, 2.0] list row is a length-2 vector, not two fields.
+        ck = marker.pack_columnar([[1.0, 2.0], [3.0, 4.0]])
+        assert ck.count == 2 and not ck.tuple_rows
+        assert ck.columns[0].shape == (2, 2)
+
+    def test_pack_columnar_ragged_falls_back(self):
+        import numpy as np
+
+        assert marker.pack_columnar(
+            [(np.zeros(3),), (np.zeros(4),)]) is None
+        assert marker.pack_columnar([]) is None
+
+    def test_next_batch_unpacks_colchunk_rows(self, mgr):
+        import numpy as np
+
+        q = mgr.get_queue("input")
+        q.put(marker.pack_columnar([(np.full(2, i, np.float32), i)
+                                    for i in range(5)]))
+        q.put(None)
+        feed = DataFeed(mgr)
+        batch = feed.next_batch(3)
+        assert [int(lab) for _, lab in batch] == [0, 1, 2]
+        batch = feed.next_batch(3)
+        assert [int(lab) for _, lab in batch] == [3, 4]
+        assert feed.should_stop()
+
+    def test_next_batch_arrays_columnar_native(self, mgr):
+        import numpy as np
+
+        q = mgr.get_queue("input")
+        for start in (0, 4):
+            q.put(marker.pack_columnar(
+                [(np.full(3, i, np.float32), i) for i in range(start, start + 4)]))
+        q.put(None)
+        feed = DataFeed(mgr)
+        arrays, count = feed.next_batch_arrays(6)  # spans chunk boundary
+        assert count == 6
+        x, y = arrays
+        assert x.shape == (6, 3) and y.tolist() == [0, 1, 2, 3, 4, 5]
+        arrays, count = feed.next_batch_arrays(6)  # partial tail + end of feed
+        assert count == 2
+        assert arrays[1].tolist() == [6, 7]
+        assert feed.should_stop()
+
+    def test_next_batch_arrays_mixed_chunk_kinds(self, mgr):
+        import numpy as np
+
+        q = mgr.get_queue("input")
+        q.put(marker.pack_columnar([(np.zeros(2, np.float32), 0),
+                                    (np.ones(2, np.float32), 1)]))
+        q.put(marker.Chunk([(np.full(2, 2.0, np.float32), 2)]))  # object chunk
+        q.put((np.full(2, 3.0, np.float32), 3))                  # loose item
+        q.put(None)
+        feed = DataFeed(mgr, input_mapping={"a_img": "x", "b_lab": "y"})
+        arrays, count = feed.next_batch_arrays(10)
+        assert count == 4
+        assert arrays["x"].shape == (4, 2)
+        assert arrays["y"].tolist() == [0, 1, 2, 3]
+
+    def test_next_batch_arrays_dtype_cast(self, mgr):
+        import numpy as np
+
+        q = mgr.get_queue("input")
+        q.put(marker.pack_columnar([(np.zeros(2, np.uint8), 1)] * 3))
+        q.put(None)
+        feed = DataFeed(mgr)
+        (x, y), count = feed.next_batch_arrays(3, dtypes=[np.float32, np.int32])
+        assert x.dtype == np.float32 and y.dtype == np.int32
+
+    def test_end_partition_respected_on_arrays_path(self, mgr):
+        import numpy as np
+
+        q = mgr.get_queue("input")
+        q.put(marker.pack_columnar([(np.zeros(1, np.float32), i)
+                                    for i in range(3)]))
+        q.put(marker.EndPartition())
+        q.put(marker.pack_columnar([(np.zeros(1, np.float32), i)
+                                    for i in range(3, 5)]))
+        q.put(None)
+        feed = DataFeed(mgr, train_mode=False)
+        _, count = feed.next_batch_arrays(10)
+        assert count == 3                       # stops at partition boundary
+        arrays, count = feed.next_batch_arrays(10)
+        assert count == 2 and arrays[1].tolist() == [3, 4]

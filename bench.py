@@ -1,53 +1,65 @@
 """Headline benchmark: the BASELINE workloads END-TO-END through the framework.
 
-Two measurements (BASELINE.md targets table):
+Three measurements (BASELINE.md targets table), each in its OWN subprocess
+(one flaky leg — e.g. a transient TPU-tunnel refusal — must never sink the
+others) with one retry:
 
-1. **MNIST images/sec/chip, end-to-end** — the reference's headline workload
-   (reference ``examples/mnist/keras/mnist_spark.py``) through the FULL
-   spark-submit-equivalent path: ``cluster.run(InputMode.SPARK)`` cluster
-   bootstrap, feed jobs pushing rows through the chunked/shm-ring data plane,
-   ``DataFeed -> ShardedFeed`` columnar assembly, ``Trainer.fit_feed`` on
-   device.  Throughput and MFU are reported by the in-run ``TimeHistory``
-   (which syncs on device completion at window boundaries).
-
-2. **ResNet-50 step time** — the reference's second headline (reference
+1. **ResNet-50 step time / MFU** — the compute headline (reference
    ``examples/resnet/resnet_imagenet_main.py:271-285``) with synthetic
    ImageNet-shaped data (the reference's own benchmark mode, reference
-   ``common.py:315-363``, reuses one synthetic batch), run inside the same
-   cluster lifecycle (FILES mode).
+   ``common.py:315-363``, reuses one device-resident batch), run inside the
+   cluster lifecycle (FILES mode).  This is the workload the >=50%-MFU
+   target is defined on; MNIST cannot exercise the MXU.
+
+2. **MNIST images/sec/chip, end-to-end** — the data-plane headline
+   (reference ``examples/mnist/keras/mnist_spark.py``) through the FULL
+   spark-submit-equivalent path: ``cluster.run(InputMode.SPARK)``, feed jobs
+   pushing uint8 pixel rows through the columnar-chunk / shm-ring plane,
+   ``DataFeed -> ShardedFeed`` columnar assembly (bytes stay uint8 until the
+   device; the cast to bf16 happens inside the jitted step), executor-side
+   epoch replay, ``Trainer.fit_feed`` on device.
+
+3. **Reference feed ceiling** — items/sec of the reference's per-element
+   manager-proxy hop (reference ``TFNode.py:124-149``), the rate that bounds
+   the reference's achievable e2e images/sec regardless of accelerator (the
+   reference publishes no numbers, BASELINE.md).
 
 Prints ONE JSON line:
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
-``vs_baseline`` compares the measured end-to-end MNIST throughput against the
-per-element feeding ceiling of the reference's InputMode.SPARK data path on
-this host (the reference moves every example through a multiprocessing-manager
-proxy hop, reference ``TFNode.py:105-151``; that rate bounds the reference's
-achievable images/sec regardless of accelerator).  The reference itself
-publishes no numbers (BASELINE.md).
-
-The driver process never imports jax: the single executor's node process
-(and its forked training child) must be the only TPU client.
+``vs_baseline`` = measured e2e MNIST rate / ceiling; null (with an error
+field) when the ceiling leg failed — a failed baseline must not read as
+"at parity" (advisor r2).
 """
 
 import argparse
 import json
 import os
+import subprocess
+import sys
 import tempfile
 import time
 
 import numpy as np
 
-MNIST_ROWS = 60000          # reference MNIST train-set size
-MNIST_BATCH = 1024
-MNIST_EPOCHS = 2
-RESNET_BATCH = 256
-RESNET_STEPS = 60
+# Env knobs shrink the workloads for smoke tests; defaults are the real bench.
+MNIST_ROWS = int(os.environ.get("TFOS_BENCH_MNIST_ROWS", 60000))  # ref train-set size
+MNIST_BATCH = int(os.environ.get("TFOS_BENCH_MNIST_BATCH", 1024))
+MNIST_EPOCHS = int(os.environ.get("TFOS_BENCH_MNIST_EPOCHS", 4))
+RESNET_BATCH = int(os.environ.get("TFOS_BENCH_RESNET_BATCH", 256))
+RESNET_STEPS = int(os.environ.get("TFOS_BENCH_RESNET_STEPS", 60))
 
+LEG_TIMEOUT_SECS = {"mnist": 1200, "resnet": 1200, "ceiling": 120}
+
+
+# ---------------------------------------------------------------------------
+# Executor-side mains
+# ---------------------------------------------------------------------------
 
 def mnist_main(args, ctx):
-    """Runs on the executor: MNIST CNN fed from the cluster data plane."""
+    """Runs on the executor: MNIST CNN fed uint8 rows from the cluster's
+    columnar data plane; pixels are cast/scaled on device."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -62,20 +74,27 @@ def mnist_main(args, ctx):
     model = mnist_mod.build_mnist(dtype="bfloat16")
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 28, 28, 1)))["params"]
+    base_loss = mnist_mod.loss_fn(model)
+
+    def loss(params, batch, mask):
+        # uint8 pixels -> bf16 in [0,1] ON DEVICE: the host<->device link
+        # (the usual bottleneck) carries 1 byte/pixel, not 4.
+        batch = dict(batch)
+        batch["image"] = batch["image"].astype(jnp.bfloat16) / 255.0
+        return base_loss(params, batch, mask)
+
     trainer = train_mod.Trainer(
-        mnist_mod.loss_fn(model), params,
-        optax.sgd(0.01, momentum=0.9), mesh=mesh,
-        compute_dtype=jnp.bfloat16, batch_size=args.batch_size,
-        log_steps=20)
+        loss, params, optax.sgd(0.01, momentum=0.9), mesh=mesh,
+        compute_dtype=None, batch_size=args.batch_size, log_steps=20)
 
-    def preprocess(items):
-        images = np.stack([r[0] for r in items]).astype(np.float32)
-        labels = np.asarray([r[1] for r in items], np.int32)
-        return {"image": images.reshape(-1, 28, 28, 1), "label": labels}
+    def transform(arrays):
+        x, y = arrays     # columnar: (N, 784) uint8, (N,) int
+        return {"image": x.reshape(-1, 28, 28, 1),
+                "label": y.astype(np.int32)}
 
-    # Warm up / compile on a synthetic batch of the same shapes, then reset
-    # the recorder so reported numbers are steady-state end-to-end.
-    warm = {"image": jnp.zeros((args.batch_size, 28, 28, 1), jnp.float32),
+    # Warm up / compile on a synthetic batch of the same shapes/dtypes, then
+    # reset the recorder so reported numbers are steady-state end-to-end.
+    warm = {"image": jnp.zeros((args.batch_size, 28, 28, 1), jnp.uint8),
             "label": jnp.zeros((args.batch_size,), jnp.int32)}
     for _ in range(3):
         trainer.step(warm)
@@ -83,7 +102,7 @@ def mnist_main(args, ctx):
 
     feed = ctx.get_data_feed(train_mode=True)
     sharded = infeed.ShardedFeed(feed, mesh, args.batch_size,
-                                 preprocess=preprocess)
+                                 transform=transform)
     # max_steps makes the run end deterministically once the step budget is
     # consumed (without it a SPARK-mode worker only stops when shutdown's
     # poison pill arrives, so the driver could never wait for the stats
@@ -138,11 +157,16 @@ def resnet_main(args, ctx):
     trainer.history.on_train_end(loss)
     stats = trainer.history.build_stats(loss=float(loss))
     stats["n_devices"] = len(jax.devices())
+    stats["device_kind"] = jax.devices()[0].device_kind
     if ctx.is_chief():
         with open(args.stats_path, "w") as f:
             json.dump(stats, f, default=float)
     return stats
 
+
+# ---------------------------------------------------------------------------
+# Leg drivers (each runs in its own subprocess; driver never imports jax)
+# ---------------------------------------------------------------------------
 
 def _run_cluster(main_fun, args, input_mode, feed_partitions=None,
                  num_epochs=1, stats_timeout=600):
@@ -155,7 +179,8 @@ def _run_cluster(main_fun, args, input_mode, feed_partitions=None,
         c = cluster.run(b, main_fun, args, num_executors=1,
                         input_mode=input_mode)
         if feed_partitions is not None:
-            c.train(feed_partitions, num_epochs=num_epochs)
+            c.train(feed_partitions, num_epochs=num_epochs,
+                    chunk_size=args.chunk_size)
             # The worker finishes (and writes its stats) shortly after its
             # max_steps budget; wait for that before poisoning the queues.
             deadline = time.time() + stats_timeout
@@ -176,13 +201,14 @@ def measure_mnist_e2e(rows=MNIST_ROWS, batch_size=MNIST_BATCH,
     from tensorflowonspark_tpu import backend, cluster
 
     rng = np.random.default_rng(0)
-    images = (rng.random((rows, 784)) * 255).astype(np.float32)
+    images = (rng.random((rows, 784)) * 255).astype(np.uint8)
     labels = rng.integers(0, 10, (rows,), np.int64)
     data = [(images[i], int(labels[i])) for i in range(rows)]
 
     args = argparse.Namespace(
         batch_size=batch_size,
         max_steps=(rows * epochs) // batch_size,
+        chunk_size=2048,
         stats_path=os.path.join(tempfile.mkdtemp(), "mnist_stats.json"))
     stats = _run_cluster(
         mnist_main, args, cluster.InputMode.SPARK,
@@ -194,7 +220,7 @@ def measure_resnet50(batch_size=RESNET_BATCH, steps=RESNET_STEPS):
     from tensorflowonspark_tpu import cluster
 
     args = argparse.Namespace(
-        batch_size=batch_size, steps=steps,
+        batch_size=batch_size, steps=steps, chunk_size=1024,
         stats_path=os.path.join(tempfile.mkdtemp(), "resnet_stats.json"))
     return _run_cluster(resnet_main, args, cluster.InputMode.FILES)
 
@@ -221,43 +247,101 @@ def measure_reference_feed_ceiling(n_items=60000):
                 qin.task_done()
             sent += 100
         elapsed = time.time() - t0
-        return sent / elapsed
+        return {"items_per_sec": sent / elapsed}
     finally:
         mgr.shutdown()
 
 
-def main():
-    mnist = measure_mnist_e2e()
-    try:
-        resnet = measure_resnet50()
-    except (Exception, SystemExit) as e:  # secondary metric: never sink the
-        resnet = {"error": str(e)}        # headline (shutdown exits 1 on a
-                                          # node failure — catch that too)
-    try:
-        ceiling = measure_reference_feed_ceiling()
-    except Exception:
-        ceiling = None
+_LEGS = {
+    "mnist": measure_mnist_e2e,
+    "resnet": measure_resnet50,
+    "ceiling": measure_reference_feed_ceiling,
+}
 
-    n_dev = max(int(mnist.get("n_devices", 1)), 1)
-    ips_per_chip = mnist["avg_exp_per_second"] / n_dev
+
+def _leg_subprocess(leg, out_path):
+    """Run one leg in a fresh interpreter; its result JSON lands in out_path."""
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--leg", leg,
+         "--out", out_path],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        timeout=LEG_TIMEOUT_SECS[leg])
+
+
+def run_leg_isolated(leg, retries=1):
+    """Execute a leg with subprocess isolation + retry; returns
+    ``(stats_or_None, error_or_None)``."""
+    err = None
+    for attempt in range(retries + 1):
+        out_path = os.path.join(tempfile.mkdtemp(), leg + ".json")
+        try:
+            proc = _leg_subprocess(leg, out_path)
+            if proc.returncode == 0 and os.path.exists(out_path):
+                with open(out_path) as f:
+                    return json.load(f), None
+            err = "leg {} rc={} (attempt {})".format(
+                leg, proc.returncode, attempt + 1)
+        except subprocess.TimeoutExpired:
+            err = "leg {} timed out after {}s (attempt {})".format(
+                leg, LEG_TIMEOUT_SECS[leg], attempt + 1)
+        except Exception as e:  # spawn failure etc.
+            err = "leg {} failed: {} (attempt {})".format(leg, e, attempt + 1)
+        print("bench: {} -- {}".format(err, "retrying" if attempt < retries
+                                       else "giving up"), file=sys.stderr)
+    return None, err
+
+
+def main():
+    resnet, resnet_err = run_leg_isolated("resnet")
+    mnist, mnist_err = run_leg_isolated("mnist")
+    ceiling, ceiling_err = run_leg_isolated("ceiling")
+
     out = {
-        "metric": "mnist_e2e_train_images_per_sec_per_chip",
-        "value": round(ips_per_chip, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(ips_per_chip / ceiling, 2) if ceiling else 1.0,
-        "mnist_mfu": round(mnist["mfu"], 4) if "mfu" in mnist else None,
-        "mnist_ms_per_step": round(1000 * mnist["avg_step_seconds"], 3)
-        if "avg_step_seconds" in mnist else None,
+        # Compute headline: the MFU target lives on ResNet-50 (BASELINE.md).
+        "metric": "resnet50_train_mfu",
+        "value": round(resnet["mfu"], 4) if resnet else None,
+        "unit": "mfu",
         "resnet50_step_time_ms": round(1000 * resnet["avg_step_seconds"], 2)
-        if "avg_step_seconds" in resnet else None,
-        "resnet50_mfu": round(resnet["mfu"], 4) if "mfu" in resnet else None,
+        if resnet else None,
         "resnet50_images_per_sec_per_chip": round(
-            resnet["avg_exp_per_second"] / max(int(resnet.get("n_devices", 1)), 1), 1)
-        if "avg_exp_per_second" in resnet else None,
-        "device_kind": mnist.get("device_kind"),
+            resnet["avg_exp_per_second"]
+            / max(int(resnet.get("n_devices", 1)), 1), 1) if resnet else None,
+        # Data-plane headline: e2e MNIST vs the reference's per-element
+        # feed ceiling.
+        "mnist_e2e_images_per_sec_per_chip": None,
+        "vs_baseline": None,
+        "mnist_ms_per_step": None,
+        "device_kind": (resnet or mnist or {}).get("device_kind"),
     }
+    if mnist:
+        n_dev = max(int(mnist.get("n_devices", 1)), 1)
+        ips = mnist["avg_exp_per_second"] / n_dev
+        out["mnist_e2e_images_per_sec_per_chip"] = round(ips, 1)
+        out["mnist_ms_per_step"] = round(1000 * mnist["avg_step_seconds"], 3)
+        if ceiling:
+            out["vs_baseline"] = round(ips / ceiling["items_per_sec"], 2)
+        if not resnet:
+            # ResNet leg failed: fall back to the data-plane headline rather
+            # than emitting a null metric (its error is still reported).
+            out["metric"] = "mnist_e2e_train_images_per_sec_per_chip"
+            out["value"] = round(ips, 1)
+            out["unit"] = "images/sec/chip"
+    for name, err in (("resnet50_error", resnet_err),
+                      ("mnist_error", mnist_err),
+                      ("ceiling_error", ceiling_err)):
+        if err:
+            out[name] = err
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--leg", choices=sorted(_LEGS))
+    parser.add_argument("--out")
+    cli = parser.parse_args()
+    if cli.leg:
+        stats = _LEGS[cli.leg]()
+        with open(cli.out, "w") as f:
+            json.dump(stats, f, default=float)
+    else:
+        main()

@@ -157,15 +157,27 @@ def should_export(ctx):
     return jax.process_count() > 1 or ctx.is_chief()
 
 
+_STABLEHLO_FILE = "apply.stablehlo"
+
+
 def export_model(export_dir, params, model_name, model_config=None,
-                 input_signature=None):
+                 input_signature=None, model=None,
+                 serialize_platforms=("cpu", "tpu")):
     """Export params + model descriptor for serving.
 
     Call according to :func:`should_export` (chief-only convention,
     reference ``mnist_spark.py:68-72``; collective in multi-process worlds).
-    The pipeline's model-transform path loads this on executors that have the
-    framework's model zoo but no user code — the portability role SavedModel
-    played for the reference (``pipeline.py:474-481``).
+    The pipeline's model-transform path loads this on executors — the
+    portability role SavedModel played for the reference
+    (``pipeline.py:474-481``).
+
+    When ``model`` (the flax module) and ``input_signature`` are given, the
+    serving fn is ALSO serialized to portable StableHLO (``jax.export``,
+    batch-polymorphic, lowered for ``serialize_platforms``): serving hosts
+    then need jax alone — no flax, no model registry, no user code (the
+    reference's user-code-free SavedModel/JNI path,
+    ``TFModel.scala:245-292``).  Registry-based serving remains the
+    fallback whenever the artifact is absent or platform-mismatched.
     """
     import jax
     import orbax.checkpoint as ocp
@@ -177,13 +189,29 @@ def export_model(export_dir, params, model_name, model_config=None,
                force=True)
     ckptr.wait_until_finished()
     ckptr.close()
+    descriptor = {
+        "model_name": model_name,
+        "model_config": model_config or {},
+        "input_signature": input_signature or {},
+    }
+    if model is not None and input_signature and jax.process_index() == 0:
+        from tensorflowonspark_tpu import serving
+
+        try:
+            blob, platforms = serving.serialize_apply(
+                model, jax.device_get(params), input_signature,
+                platforms=serialize_platforms)
+            with open(os.path.join(export_dir, _STABLEHLO_FILE), "wb") as f:
+                f.write(blob)
+            descriptor["stablehlo"] = {"file": _STABLEHLO_FILE,
+                                       "platforms": list(platforms)}
+        except Exception:
+            # The orbax+registry path still serves; don't fail the export.
+            logger.warning("StableHLO serialization failed; export remains "
+                           "registry-served", exc_info=True)
     if jax.process_index() == 0:
         with open(os.path.join(export_dir, _DESCRIPTOR), "w") as f:
-            json.dump({
-                "model_name": model_name,
-                "model_config": model_config or {},
-                "input_signature": input_signature or {},
-            }, f)
+            json.dump(descriptor, f)
     logger.info("exported %s to %s", model_name, export_dir)
 
 

@@ -29,58 +29,27 @@ from tensorflowonspark_tpu import dfutil, schema as schema_mod
 logger = logging.getLogger(__name__)
 
 
-def run_inference(export_dir, rows, input_mapping=None, output_name="prediction",
-                  batch_size=128, input_signature=None):
+def run_inference(export_dir, rows, input_mapping=None, output_name=None,
+                  output_mapping=None, batch_size=128):
     """Yield one output row dict per input row (1:1 contract, reference
-    ``TFModel.scala:265-281`` / ``pipeline.py:509-512``)."""
-    import jax
+    ``TFModel.scala:265-281`` / ``pipeline.py:509-512``).
 
-    from tensorflowonspark_tpu import checkpoint
-    from tensorflowonspark_tpu.models import get_model
+    N input tensors via ``input_mapping`` ``{column: tensor}`` and M output
+    columns via ``output_mapping`` ``{tensor: column}`` — the full
+    multi-tensor serving surface (see
+    :class:`~tensorflowonspark_tpu.serving.ModelServer`).  ``output_name``
+    is the single-output shorthand (kept for CLI/back compatibility): it
+    renames a single-output model's ``prediction`` column.
+    """
+    from tensorflowonspark_tpu import serving
 
-    params, desc = checkpoint.load_model(export_dir)
-    model = get_model(desc["model_name"], **desc.get("model_config", {}))
-    signature = input_signature or desc.get("input_signature") or {}
-    apply_fn = jax.jit(lambda p, x: model.apply({"params": p}, x))
-
-    if input_mapping:
-        (in_col, tensor_name), = input_mapping.items()  # single-input models
-    else:
-        in_col = tensor_name = next(iter(signature)) if signature else None
-
-    # The export's input_signature is keyed by TENSOR name (checkpoint.
-    # export_model), so the lookup must use the mapping's tensor name, not
-    # the DataFrame column name — they differ whenever input_mapping
-    # renames.  Falling back to "the first entry" is only safe when the
-    # signature has exactly one input.
-    shape = None
-    if signature:
-        shape = signature.get(tensor_name)
-        if shape is None:
-            if len(signature) > 1:
-                raise ValueError(
-                    "tensor {!r} (from input_mapping) not found in the "
-                    "export's multi-input signature {}; cannot guess which "
-                    "input it feeds".format(tensor_name, sorted(signature)))
-            shape = next(iter(signature.values()))
-
-    for lo in range(0, len(rows), batch_size):
-        chunk = rows[lo:lo + batch_size]
-        if in_col is not None and isinstance(chunk[0], dict):
-            x = np.asarray([r[in_col] for r in chunk], np.float32)
-        else:
-            x = np.asarray(chunk, np.float32)
-        if shape is not None:
-            x = x.reshape([-1] + list(shape[1:]))
-        count = len(chunk)
-        if count < batch_size:
-            pad = [(0, batch_size - count)] + [(0, 0)] * (x.ndim - 1)
-            x = np.pad(x, pad)
-        preds = np.asarray(apply_fn(params, x))[:count]
-        for row, pred in zip(chunk, preds):
-            out = dict(row) if isinstance(row, dict) else {}
-            out[output_name] = pred.tolist()
-            yield out
+    server = serving.ModelServer(export_dir, batch_size)
+    for row in server.run_rows_dict(iter(rows), input_mapping=input_mapping,
+                                    output_mapping=output_mapping):
+        if output_name and output_name != "prediction" and "prediction" in row:
+            # single-output shorthand: rename the default column
+            row[output_name] = row.pop("prediction")
+        yield row
 
 
 def main(argv=None):
@@ -95,8 +64,8 @@ def main(argv=None):
     parser.add_argument("--input_mapping", default=None,
                         help='JSON {"column": "tensor"} (reference -i)')
     parser.add_argument("--output_mapping", default=None,
-                        help='JSON {"tensor": "column"}; the single output '
-                             "column name (reference -o)")
+                        help='JSON {"tensor": "column"}, one entry per '
+                             "output tensor (reference -o)")
     parser.add_argument("--batch_size", type=int, default=128)
     parser.add_argument("--output", default=None,
                         help="output JSON-lines path (stdout when omitted)")
@@ -104,9 +73,8 @@ def main(argv=None):
 
     hint = schema_mod.parse(args.schema_hint) if args.schema_hint else None
     input_mapping = json.loads(args.input_mapping) if args.input_mapping else None
-    output_name = "prediction"
-    if args.output_mapping:
-        output_name = next(iter(json.loads(args.output_mapping).values()))
+    output_mapping = (json.loads(args.output_mapping)
+                      if args.output_mapping else None)
 
     rows = dfutil.load_tfrecords(args.input, schema=hint)
     logger.info("loaded %d rows from %s (schema %s)",
@@ -117,7 +85,7 @@ def main(argv=None):
         n = 0
         for out in run_inference(args.export_dir, rows,
                                  input_mapping=input_mapping,
-                                 output_name=output_name,
+                                 output_mapping=output_mapping,
                                  batch_size=args.batch_size):
             out_f.write(json.dumps(out) + "\n")
             n += 1

@@ -40,4 +40,5 @@ def get_model(name, **config):
 
 
 # Import for registration side effects.
-from tensorflowonspark_tpu.models import linear, mnist, resnet, unet, transformer  # noqa: E402,F401
+from tensorflowonspark_tpu.models import (  # noqa: E402,F401
+    linear, mnist, resnet, transformer, twotower, unet)

@@ -1,0 +1,53 @@
+"""Two-tower retrieval model — the zoo's multi-input / multi-output family.
+
+The reference's serving layer is defined over SavedModels with N input and M
+output tensors (reference ``pipeline.py:469-518``, ``TFModel.scala:51-239``);
+this model exercises that surface natively: two named inputs (``user``,
+``item``) and two named outputs (``score``, ``user_embedding``), the classic
+recommender two-tower shape.  Multi-input models are called by tensor-name
+keyword and return a dict of named outputs — the conventions
+:mod:`~tensorflowonspark_tpu.serving` serves.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import register_model
+
+
+class TwoTower(nn.Module):
+    """Dense towers over each input; dot-product score.
+
+    MXU-friendly: both towers are plain matmuls, bf16-capable, static shapes.
+    """
+
+    embed_dim: int = 8
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, user, item):
+        u = nn.Dense(self.embed_dim, dtype=self.dtype, name="user_tower")(
+            user.astype(self.dtype))
+        v = nn.Dense(self.embed_dim, dtype=self.dtype, name="item_tower")(
+            item.astype(self.dtype))
+        score = (u * v).sum(axis=-1).astype(jnp.float32)
+        return {"score": score, "user_embedding": u.astype(jnp.float32)}
+
+
+@register_model("two_tower")
+def build_two_tower(embed_dim=8, dtype="float32"):
+    return TwoTower(embed_dim=embed_dim, dtype=jnp.dtype(dtype))
+
+
+def loss_fn(model):
+    """Masked MSE on the score head, for the Trainer contract.  The batch
+    carries ``user`` / ``item`` inputs and a ``label`` target score."""
+
+    def loss(params, batch, mask):
+        out = model.apply({"params": params},
+                          user=batch["user"], item=batch["item"])
+        err = (out["score"] - batch["label"]) ** 2
+        mse = (err * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return mse, {}
+
+    return loss

@@ -264,9 +264,11 @@ class TFModel(TFParams, _MLModel):
             return _MLModel.transform(self, dataset, params)
         return self._transform(dataset, num_partitions)
 
-    def _output_column(self):
+    def _output_columns(self):
+        """Output column names in mapping order (``["prediction"]`` when no
+        output_mapping is set)."""
         out_map = self.get("output_mapping")
-        return next(iter(out_map.values())) if out_map else "prediction"
+        return list(out_map.values()) if out_map else ["prediction"]
 
     def _transform(self, dataset, num_partitions=None):
         from tensorflowonspark_tpu import backend as backend_mod
@@ -276,16 +278,21 @@ class TFModel(TFParams, _MLModel):
         input_cols = (sorted(self.get("input_mapping"))
                       if self.get("input_mapping") else None)
         rows, cols = _dataset_rows(dataset, input_cols)
-        run = _run_model_fn(export_dir, self.get("batch_size"))
+        run = _run_model_fn(export_dir, self.get("batch_size"),
+                            input_mapping=self.get("input_mapping"),
+                            output_mapping=self.get("output_mapping"))
 
+        out_cols = self._output_columns()
         if hasattr(rows, "mapPartitions"):  # Spark RDD path
             out_rdd = rows.mapPartitions(run)
             spark = getattr(dataset, "sparkSession", None)
             if spark is None:
                 return out_rdd
-            # DataFrame in -> DataFrame out (reference pipeline.py:445-446)
-            return spark.createDataFrame(out_rdd.map(lambda p: (p,)),
-                                         [self._output_column()])
+            # DataFrame in -> DataFrame out, one column per output tensor
+            # (reference pipeline.py:445-446; M columns like TFModel.scala)
+            if len(out_cols) == 1:
+                out_rdd = out_rdd.map(lambda p: (p,))
+            return spark.createDataFrame(out_rdd, out_cols)
         num_partitions = num_partitions or getattr(
             self.backend, "num_executors", 1)
         parts = backend_mod.partition(rows, num_partitions)
@@ -295,54 +302,31 @@ class TFModel(TFParams, _MLModel):
         return [out for part in results if part for out in part]
 
 
-def _run_model_fn(export_dir, batch_size):
+def _run_model_fn(export_dir, batch_size, input_mapping=None,
+                  output_mapping=None):
     """Build the per-partition inference closure (reference ``_run_model``,
-    ``pipeline.py:454-520``); the closure is cloudpickled to executors."""
+    ``pipeline.py:454-520``); the closure is cloudpickled to executors.
+    Rows in, output rows out — a bare value per row for single-output
+    models, a tuple of output-column values for multi-output models."""
 
     def _run_model(iterator):
-        import jax
-        import numpy as np
-
         import tensorflowonspark_tpu.pipeline as pipeline_mod
 
         # Process-global cache: load/compile once per executor process, reuse
         # across partitions (reference pipeline.py:474-481).  The module must
         # be referenced absolutely — this closure runs cloudpickled, so its
-        # own module globals would be by-value copies.
-        cached = pipeline_mod._model_cache.get(export_dir)
-        if cached is None:
-            from tensorflowonspark_tpu import checkpoint, models
+        # own module globals would be by-value copies.  batch_size is part
+        # of the key: a later transform with a different batch size must not
+        # silently reuse a server padded for the old one.
+        key = (export_dir, batch_size)
+        server = pipeline_mod._model_cache.get(key)
+        if server is None:
+            from tensorflowonspark_tpu import serving
 
-            params, desc = checkpoint.load_model(export_dir)
-            model = models.get_model(desc["model_name"],
-                                     **desc.get("model_config", {}))
-
-            @jax.jit
-            def predict(p, x):
-                return model.apply({"params": p}, x)
-
-            cached = (params, desc, predict)
-            pipeline_mod._model_cache[export_dir] = cached
-            logger.info("loaded model %s from %s", desc["model_name"], export_dir)
-        params, desc, predict = cached
-        signature = desc.get("input_signature") or {}
-        shape = next(iter(signature.values())) if signature else None
-
-        outputs = []
-        for batch, count in yield_batch(iterator, batch_size):
-            x = np.asarray(batch, dtype=np.float32)
-            if shape is not None:
-                # flat row arrays -> tensor shape (reference pipeline.py:497-502)
-                x = x.reshape([-1] + list(shape[1:]))
-            if count < batch_size:
-                # pad the tail so the jit cache sees one static shape
-                pad = [(0, batch_size - count)] + [(0, 0)] * (x.ndim - 1)
-                x = np.pad(x, pad)
-            preds = np.asarray(predict(params, x))[:count]
-            # one output row per input row (reference's 1:1 assert,
-            # pipeline.py:509-512)
-            outputs.extend(p.tolist() for p in preds)
-        return outputs
+            server = serving.ModelServer(export_dir, batch_size)
+            pipeline_mod._model_cache[key] = server
+        return list(server.run_rows(iterator, input_mapping=input_mapping,
+                                    output_mapping=output_mapping))
 
     return _run_model
 

@@ -1,0 +1,330 @@
+"""Multi-tensor serving core, shared by the pipeline transform and the
+inference CLI.
+
+The reference serves SavedModels with N input tensors and M output tensors:
+``_run_model`` feeds a dict of input tensors with per-tensor shape coercion
+and zips the fetched output tensors into M output columns (reference
+``pipeline.py:469-518``); its JVM twin converts every scalar/1-D SQL type in
+both directions (reference ``TFModel.scala:51-239``).  This module is the
+framework-native equivalent over the export artifact
+(:func:`~tensorflowonspark_tpu.checkpoint.export_model`):
+
+- inputs: ``input_mapping`` ``{column: tensor}`` with the sorted-column
+  contract (columns ordered by sorted name map positionally to row fields —
+  the same convention as ``DataFeed``/``_dataset_rows``); per-tensor dtype
+  and shape coercion from the export's input signature;
+- apply: single-input models are called positionally, multi-input models by
+  tensor-name keyword (the flax-native calling convention);
+- outputs: models may return a single array, a tuple, or a dict of named
+  outputs; ``output_mapping`` ``{tensor: column}`` zips them into M output
+  columns (1:1 row contract, reference ``pipeline.py:509-512``).
+"""
+
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _normalize_signature(signature):
+    """Export signatures may be ``{tensor: shape_list}`` (legacy) or
+    ``{tensor: {"shape": [...], "dtype": "float32"}}``; normalize to the
+    dict form."""
+    out = {}
+    for name, spec in (signature or {}).items():
+        if isinstance(spec, dict):
+            out[name] = {"shape": spec.get("shape"),
+                         "dtype": spec.get("dtype", "float32")}
+        else:
+            out[name] = {"shape": spec, "dtype": "float32"}
+    return out
+
+
+def build_apply_fn(model, signature):
+    """The framework's serving calling convention, in one place (shared by
+    live serving and the StableHLO serializer so artifacts and registry
+    serving can never drift): multi-input models are applied by tensor-name
+    keyword, single-input models positionally; the fn signature is always
+    ``(params, {tensor: array}) -> outputs``."""
+    if len(signature) > 1:
+        def apply_fn(p, inputs):
+            return model.apply({"params": p}, **inputs)
+    else:
+        def apply_fn(p, inputs):
+            (x,) = inputs.values()
+            return model.apply({"params": p}, x)
+    return apply_fn
+
+
+def serialize_apply(model, params, input_signature, platforms=("cpu", "tpu")):
+    """Serialize the model's serving fn to portable StableHLO bytes
+    (``jax.export``): shape-polymorphic in the batch dim, lowered for every
+    target platform — the self-describing artifact role SavedModel played
+    for the reference (``TFModel.scala:245-292``, SURVEY §2.3).  A host
+    holding these bytes serves with jax alone: no flax, no model registry,
+    no user code.
+    """
+    import jax
+    from jax import export as jexport
+
+    sig = _normalize_signature(input_signature)
+    apply_fn = build_apply_fn(model, sig)
+    batch = jexport.symbolic_shape("b")[0]
+    ispec = {}
+    for tensor, spec in sig.items():
+        shape = list(spec["shape"] or [None])
+        dims = [batch] + [d for d in shape[1:]]
+        for i, d in enumerate(dims[1:], start=1):
+            if d is None:
+                raise ValueError(
+                    "input {!r} has a non-batch dynamic dim {}; StableHLO "
+                    "export needs concrete non-batch dims".format(tensor, i))
+        ispec[tensor] = jax.ShapeDtypeStruct(tuple(dims),
+                                             np.dtype(spec["dtype"]))
+    pspec = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        params)
+    exported = jexport.export(jax.jit(apply_fn),
+                              platforms=tuple(platforms))(pspec, ispec)
+    return exported.serialize(), exported.platforms
+
+
+class ModelServer(object):
+    """Loads an export once and serves batched jit inference.
+
+    Prefers the export's **StableHLO artifact** (``apply.stablehlo``,
+    written by :func:`~tensorflowonspark_tpu.checkpoint.export_model`) —
+    serving then needs no flax and no model registry on the host, the
+    user-code-free portability SavedModel gave the reference.  Falls back
+    to rebuilding the model from the registry by descriptor name.
+
+    One instance per export per process (the pipeline keeps a process-global
+    cache, reference ``pipeline.py:449-451``); the jit cache sees a single
+    static batch shape because tails are padded.
+    """
+
+    def __init__(self, export_dir, batch_size=128):
+        import jax
+
+        from tensorflowonspark_tpu import checkpoint
+
+        params, desc = checkpoint.load_model(export_dir)
+        self.batch_size = batch_size
+        self.params = params
+        self.descriptor = desc
+        self.signature = _normalize_signature(desc.get("input_signature"))
+        self.from_stablehlo = False
+
+        exported = self._load_stablehlo(export_dir, desc)
+        if exported is not None:
+            self._predict = jax.jit(exported.call)
+            self.from_stablehlo = True
+        else:
+            from tensorflowonspark_tpu.models import get_model
+
+            model = get_model(desc["model_name"],
+                              **desc.get("model_config", {}))
+            self._predict = jax.jit(build_apply_fn(model, self.signature))
+        logger.info("loaded model %s from %s (inputs: %s, stablehlo: %s)",
+                    desc["model_name"], export_dir,
+                    sorted(self.signature) or "<unnamed>",
+                    self.from_stablehlo)
+
+    @staticmethod
+    def _load_stablehlo(export_dir, desc):
+        """Deserialize the StableHLO serving fn when present and lowered for
+        this host's platform; None otherwise."""
+        import os
+
+        import jax
+        from jax import export as jexport
+
+        from tensorflowonspark_tpu.checkpoint import _fs_path
+
+        hlo = desc.get("stablehlo")
+        if not hlo:
+            return None
+        path = os.path.join(_fs_path(export_dir), hlo["file"])
+        if not os.path.exists(path):
+            return None
+        platform = jax.default_backend()
+        platforms = [p.lower() for p in hlo.get("platforms", [])]
+        if platforms and platform not in platforms:
+            logger.warning(
+                "stablehlo artifact lowered for %s but host platform is %s; "
+                "falling back to registry serving", platforms, platform)
+            return None
+        with open(path, "rb") as f:
+            return jexport.deserialize(bytearray(f.read()))
+
+    # -- input assembly ---------------------------------------------------
+
+    def _feed_spec(self, input_mapping):
+        """Feed order as ``[(column, tensor), ...]``: sorted by column name
+        when a mapping is given (the sorted-column contract), else the
+        signature's sorted tensor names with no column binding."""
+        if input_mapping:
+            return sorted(input_mapping.items())
+        if self.signature:
+            return [(None, t) for t in sorted(self.signature)]
+        return [(None, None)]  # unnamed single input
+
+    def _coerce(self, tensor, col):
+        """Apply the signature's dtype/shape to one input column."""
+        spec = None
+        if tensor and self.signature:
+            spec = self.signature.get(tensor)
+            if spec is None:
+                # A typo'd tensor name would otherwise surface later as an
+                # obscure apply/pytree error (or silently skip reshaping).
+                raise ValueError(
+                    "tensor {!r} (from input_mapping) not in the export's "
+                    "input signature {}".format(tensor,
+                                                sorted(self.signature)))
+        dtype = np.dtype(spec["dtype"]) if spec else np.float32
+        x = np.asarray(col, dtype=dtype)
+        if spec and spec.get("shape"):
+            # flat row arrays -> tensor shape (reference pipeline.py:497-502)
+            x = x.reshape([-1] + list(spec["shape"][1:]))
+        return x
+
+    def _feed_dict(self, rows, spec):
+        """Build ``{tensor: array}`` from a batch of rows.
+
+        Dict rows are read by column name (CLI path; needs the mapping's
+        column binding); tuple rows positionally in sorted-column order
+        (pipeline path); bare values feed a single input directly.
+        """
+        dict_rows = bool(rows) and isinstance(rows[0], dict)
+        if len(spec) == 1:
+            column, tensor = spec[0]
+            if dict_rows:
+                if column is None:
+                    if len(rows[0]) == 1:
+                        column = next(iter(rows[0]))
+                    elif tensor and tensor in rows[0]:
+                        column = tensor  # unmapped: column named after tensor
+                    else:
+                        raise ValueError(
+                            "dict rows with columns {} need an input_mapping "
+                            "naming the input column (no column matches the "
+                            "signature tensor {!r})".format(
+                                sorted(rows[0]), tensor))
+                vals = [r[column] for r in rows]
+            else:
+                vals = rows
+            return {tensor or "_x": self._coerce(tensor, vals)}
+        if not dict_rows and rows and len(rows[0]) != len(spec):
+            # Positional feeding with mismatched arity would silently bind
+            # the wrong columns to tensors — wrong predictions, no error.
+            raise ValueError(
+                "rows have {} fields but the feed maps {} tensors {}; pass "
+                "an input_mapping selecting exactly the input columns".format(
+                    len(rows[0]), len(spec), [t for _, t in spec]))
+        feed = {}
+        for f, (column, tensor) in enumerate(spec):
+            if dict_rows:
+                if column is None:
+                    column = tensor  # unmapped: column named after tensor
+                vals = [r[column] for r in rows]
+            else:
+                vals = [r[f] for r in rows]
+            feed[tensor] = self._coerce(tensor, vals)
+        return feed
+
+    # -- prediction -------------------------------------------------------
+
+    def predict_feed(self, feed, count):
+        """Run one (padded) batch; returns the raw model outputs sliced back
+        to ``count`` rows, normalized to a dict of arrays."""
+        if count < self.batch_size:
+            def pad(x):
+                width = [(0, self.batch_size - count)] + [(0, 0)] * (x.ndim - 1)
+                return np.pad(x, width)
+
+            feed = {k: pad(v) for k, v in feed.items()}
+        out = self._predict(self.params, feed)
+        return {k: np.asarray(v)[:count] for k, v in _name_outputs(out).items()}
+
+    def run_rows(self, iterator, input_mapping=None, output_mapping=None):
+        """Yield one tuple of output-column values per input row (a bare
+        value for single-output models) — the pipeline transform contract."""
+        from tensorflowonspark_tpu.pipeline import yield_batch
+
+        spec = self._feed_spec(input_mapping)
+        for rows, count in yield_batch(iterator, self.batch_size):
+            outputs = self.predict_feed(self._feed_dict(rows, spec), count)
+            cols = output_columns(output_mapping, outputs,
+                                  allow_unmapped_multi=False)
+            series = [outputs[t] for t, _ in cols]
+            if len(series) == 1:
+                for i in range(count):
+                    yield _pyval(series[0][i])
+            else:
+                for i in range(count):
+                    yield tuple(_pyval(s[i]) for s in series)
+
+    def run_rows_dict(self, iterator, input_mapping=None, output_mapping=None):
+        """Yield ``{column: value}`` dicts merged over dict input rows — the
+        inference-CLI contract (reference ``Inference.scala`` JSON output)."""
+        from tensorflowonspark_tpu.pipeline import yield_batch
+
+        spec = self._feed_spec(input_mapping)
+        for rows, count in yield_batch(iterator, self.batch_size):
+            outputs = self.predict_feed(self._feed_dict(rows, spec), count)
+            cols = output_columns(output_mapping, outputs)
+            for i in range(count):
+                out = dict(rows[i]) if isinstance(rows[i], dict) else {}
+                for tensor, column in cols:
+                    out[column] = _pyval(outputs[tensor][i])
+                yield out
+
+
+def _name_outputs(out):
+    """Normalize a model's return value to ``{tensor_name: array}``:
+    dicts pass through, tuples/lists get positional ``output_<i>`` names,
+    a single array becomes ``{"output": array}``."""
+    if isinstance(out, dict):
+        return out
+    if isinstance(out, (tuple, list)):
+        return {"output_{}".format(i): v for i, v in enumerate(out)}
+    return {"output": out}
+
+
+def output_columns(output_mapping, outputs, allow_unmapped_multi=True):
+    """Resolve ``output_mapping`` ``{tensor: column}`` against the model's
+    named outputs; returns ``[(tensor, column), ...]`` in mapping order
+    (insertion order, like the reference's zip of fetches,
+    ``pipeline.py:506-518``).  Without a mapping: single-output models get
+    the ``prediction`` column; multi-output models get one column per
+    output tensor named after itself — unless ``allow_unmapped_multi`` is
+    False (the pipeline-transform contract, whose callers size their output
+    schema as one column when no mapping is set)."""
+    if output_mapping:
+        if len(outputs) == 1 and len(output_mapping) == 1:
+            # Single-output models have no intrinsic tensor name; a
+            # single-entry mapping binds to the sole output whatever its key
+            # (the reference's SavedModel fetch-by-name has no analog here).
+            return [(next(iter(outputs)), next(iter(output_mapping.values())))]
+        missing = [t for t in output_mapping if t not in outputs]
+        if missing:
+            raise ValueError(
+                "output_mapping names tensors {} not among the model "
+                "outputs {}".format(missing, sorted(outputs)))
+        return list(output_mapping.items())
+    if len(outputs) == 1:
+        return [(next(iter(outputs)), "prediction")]
+    if not allow_unmapped_multi:
+        raise ValueError(
+            "this model has {} named outputs {}; set an output_mapping "
+            "{{tensor: column}} to choose/ name the output columns".format(
+                len(outputs), sorted(outputs)))
+    return [(t, t) for t in sorted(outputs)]
+
+
+def _pyval(x):
+    """ndarray cell -> plain Python value (scalars stay scalars, vectors
+    become lists — the SQL-type conversion role of ``TFModel.scala:51-239``)."""
+    arr = np.asarray(x)
+    return arr.item() if arr.ndim == 0 else arr.tolist()

@@ -93,3 +93,58 @@ def test_inference_cli_end_to_end(tmp_path):
     for row, expect in zip(preds, want):
         assert abs(row["score"][0] - expect) < 1e-4
         assert "x" in row  # input columns carried through
+
+
+def test_inference_cli_multi_input_output(tmp_path):
+    """CLI multi-tensor parity: 2 input tensors fed by column mapping, 2
+    output tensors zipped into 2 output columns (reference Inference.scala +
+    TFModel.scala:51-239)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.models import get_model
+
+    model = get_model("two_tower", embed_dim=4)
+    params = model.init(jax.random.PRNGKey(0), user=jnp.zeros((1, 3)),
+                        item=jnp.zeros((1, 3)))["params"]
+    params = jax.tree_util.tree_map(np.asarray, params)
+    export_dir = str(tmp_path / "export")
+    checkpoint.export_model(
+        export_dir, params, "two_tower", model_config={"embed_dim": 4},
+        input_signature={"user": {"shape": [None, 3], "dtype": "float32"},
+                         "item": {"shape": [None, 3], "dtype": "float32"}})
+
+    rng = np.random.default_rng(7)
+    rows = [{"u": rng.random(3).astype(np.float32).tolist(),
+             "i": rng.random(3).astype(np.float32).tolist()} for _ in range(5)]
+    data_dir = str(tmp_path / "tfr")
+    dfutil.save_as_tfrecords(
+        rows, data_dir, schema={"u": "array<float32>", "i": "array<float32>"})
+
+    out_path = str(tmp_path / "preds.jsonl")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""})
+    proc = subprocess.run(
+        [sys.executable, "-m", "tensorflowonspark_tpu.inference_cli",
+         "--export_dir", export_dir, "--input", data_dir,
+         "--schema_hint", "struct<u:array<float>,i:array<float>>",
+         "--input_mapping", json.dumps({"u": "user", "i": "item"}),
+         "--output_mapping", json.dumps({"score": "score",
+                                         "user_embedding": "emb"}),
+         "--batch_size", "3", "--output", out_path],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.join(os.path.dirname(__file__), ".."))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    preds = [json.loads(line) for line in open(out_path)]
+    assert len(preds) == 5
+    # ground truth via direct apply on the same rows (TFRecord round trip
+    # preserves the float32 values)
+    users = np.asarray([p["u"] for p in preds], np.float32)
+    items = np.asarray([p["i"] for p in preds], np.float32)
+    ref = model.apply({"params": params}, user=users, item=items)
+    for k, p in enumerate(preds):
+        assert abs(p["score"] - float(ref["score"][k])) < 1e-4
+        np.testing.assert_allclose(p["emb"], np.asarray(ref["user_embedding"][k]),
+                                   rtol=1e-5)

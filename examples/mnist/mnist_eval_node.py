@@ -1,0 +1,145 @@
+"""MNIST training with a dedicated evaluator node (reference
+``examples/mnist/estimator/mnist_tf.py:109-115`` — ``train_and_evaluate``
+with an ``eval_node``).
+
+Workers train in the shared ``jax.distributed`` world and checkpoint
+periodically; the **evaluator** runs its OWN single-process jax world (it is
+not part of the workers' world — a different program inside the same world
+would wedge the collectives, see ``node._JAX_JOBS``), polls the checkpoint
+directory, restores the newest step, and writes eval metrics until the
+cluster shuts it down.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python examples/mnist/mnist_eval_node.py --cluster_size 3
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _build(args):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import mnist as mnist_mod
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.build_mesh()
+    model = mnist_mod.build_mnist(dtype="bfloat16")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    trainer = train_mod.Trainer(
+        mnist_mod.loss_fn(model), params,
+        optax.sgd(args.lr, momentum=0.9), mesh=mesh,
+        compute_dtype=jnp.bfloat16, batch_size=args.batch_size)
+    return model, trainer
+
+
+def _synthetic_batch(args, seed):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return {
+        "image": rng.random((args.batch_size, 28, 28, 1), np.float32),
+        "label": rng.integers(0, 10, (args.batch_size,), np.int64),
+    }
+
+
+def evaluator_fun(args, ctx):
+    """Runs on the evaluator node: its own jax world, restore + evaluate each
+    new checkpoint (the reference eval_node's continuous-eval loop)."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu.models import mnist as mnist_mod
+
+    assert ctx.process_id is None  # not a slot in the workers' world
+    model = mnist_mod.build_mnist(dtype="bfloat16")
+    loss = mnist_mod.loss_fn(model)
+    eval_batch = _synthetic_batch(args, seed=1234)
+    mask = np.ones((args.batch_size,), np.float32)
+    model_dir = ctx.absolute_path(args.model_dir)
+
+    _, trainer = _build(args)
+    mgr = checkpoint.CheckpointManager(model_dir, save_interval_steps=0)
+    seen = -1
+    deadline = time.time() + args.eval_timeout
+    while time.time() < deadline:
+        state, step = mgr.restore_latest(jax.device_get(trainer.state))
+        if step is not None and step > seen:
+            seen = step
+            l, aux = loss(state.params, eval_batch, mask)
+            metrics = {"step": int(step), "loss": float(l),
+                       "accuracy": float(aux["accuracy"])}
+            with open("eval_metrics.jsonl", "a") as f:
+                f.write(json.dumps(metrics) + "\n")
+            print("evaluator: step {} loss {:.4f} acc {:.3f}".format(
+                step, metrics["loss"], metrics["accuracy"]))
+            if step >= args.max_steps:
+                break
+        time.sleep(1)
+    mgr.close()
+
+
+def main_fun(args, ctx):
+    """Dispatch by role: workers train + checkpoint, evaluator evaluates."""
+    if ctx.job_name == "evaluator":
+        evaluator_fun(args, ctx)
+        return
+
+    import jax
+
+    from tensorflowonspark_tpu import checkpoint
+
+    ctx.initialize_distributed()
+    _, trainer = _build(args)
+    mgr = checkpoint.CheckpointManager(
+        ctx.absolute_path(args.model_dir),
+        save_interval_steps=args.save_interval)
+    batch = _synthetic_batch(args, seed=ctx.process_id or 0)
+    for step in range(1, args.max_steps + 1):
+        trainer.step(batch)
+        mgr.maybe_save(step, jax.device_get(trainer.state),
+                       force=step == args.max_steps)
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def main(argv=None):
+    from tensorflowonspark_tpu import backend, cluster
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--cluster_size", type=int, default=3,
+                        help="workers + 1 evaluator")
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--max_steps", type=int, default=30)
+    parser.add_argument("--save_interval", type=int, default=10)
+    parser.add_argument("--eval_timeout", type=int, default=120)
+    parser.add_argument("--model_dir", default="mnist_eval_model")
+    args, _ = parser.parse_known_args(argv)
+    # Checkpoints must live on storage every node can reach (executors each
+    # have their own cwd); absolutize against the driver's cwd for the
+    # local-backend case — in real deployments pass shared storage.
+    args.model_dir = os.path.abspath(args.model_dir)
+
+    b = backend.LocalBackend(args.cluster_size)
+    try:
+        c = cluster.run(b, main_fun, args, num_executors=args.cluster_size,
+                        eval_node=True, input_mode=cluster.InputMode.FILES)
+        c.shutdown(grace_secs=5)
+    finally:
+        b.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -92,7 +92,13 @@ class CheckpointManager(object):
 
     def restore_latest(self, abstract_state):
         """Restore the newest checkpoint into the structure of
-        ``abstract_state``; returns (state, step) or (None, None)."""
+        ``abstract_state``; returns (state, step) or (None, None).
+
+        Re-reads the step list from storage first: orbax caches it at
+        manager creation, and the callers of this method (recovery after
+        restart, a polling evaluator node) are exactly the ones racing
+        another process's writes."""
+        self._mgr.reload()
         step = self._mgr.latest_step()
         if step is None:
             return None, None

@@ -35,9 +35,14 @@ from tensorflowonspark_tpu import manager, marker, reservation, util
 
 logger = logging.getLogger(__name__)
 
-# Job names that host a JAX computation and therefore get a process_id in the
-# jax.distributed world (ps parks on a control queue and never runs jax).
-_JAX_JOBS = ("chief", "master", "worker", "evaluator")
+# Job names that join the shared jax.distributed world and get a process_id.
+# ps parks on a control queue and never runs jax.  The evaluator runs jax but
+# in its OWN single-process world: it executes a different program than the
+# workers (periodic eval over checkpoints, reference
+# ``examples/mnist/estimator/mnist_tf.py:109-115``), and a process running a
+# different program inside the workers' jax.distributed world would wedge
+# every collective while inflating num_processes.
+_JAX_JOBS = ("chief", "master", "worker")
 
 # Executor-process-lifetime state (reference "TFSparkNode singleton holder",
 # ``TFSparkNode.py:75-89``): keeps the manager handle referenced after the

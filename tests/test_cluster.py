@@ -242,3 +242,56 @@ def test_columnar_feed_epochs_and_chunk_size(local_backend):
             labels += int(s)
     assert rows_seen == 20 * 3
     assert labels == sum(range(20)) * 3
+
+
+def test_evaluator_role_own_world(tmp_path):
+    """eval_node parity (reference mnist_tf.py:109-115 train_and_evaluate):
+    the evaluator is NOT part of the workers' jax.distributed world (its own
+    single-process world reads checkpoints), workers' num_processes excludes
+    it, and shutdown signals it via its control queue like a ps node."""
+    import argparse
+    import json
+    import time
+
+    shared = str(tmp_path / "shared")
+    os.makedirs(shared, exist_ok=True)
+
+    def map_fun(args, ctx):
+        import jax
+
+        if ctx.job_name == "evaluator":
+            # own world: no slot in the workers' jax.distributed job set
+            assert ctx.process_id is None, ctx.process_id
+            ckpt = os.path.join(args.shared, "ckpt.json")
+            deadline = time.time() + 60
+            while not os.path.exists(ckpt) and time.time() < deadline:
+                time.sleep(0.2)
+            with open(ckpt) as f:
+                w = json.load(f)["w"]
+            # evaluate on this node's own single-process jax world
+            result = float(jax.jit(lambda x: x * 2)(w))
+            with open(os.path.join(args.shared, "eval.json"), "w") as f:
+                json.dump({"eval": result}, f)
+            return
+        # workers: the shared world has exactly the two worker slots
+        assert ctx.num_processes == 2, ctx.num_processes
+        assert ctx.process_id in (0, 1)
+        if ctx.is_chief():
+            with open(os.path.join(args.shared, "ckpt.json"), "w") as f:
+                json.dump({"w": 21}, f)
+
+    b = backend.LocalBackend(3)
+    try:
+        args = argparse.Namespace(shared=shared)
+        c = cluster.run(b, map_fun, args, num_executors=3, eval_node=True,
+                        input_mode=InputMode.FILES)
+        assert {n["job_name"] for n in c.cluster_info} == {"worker", "evaluator"}
+        c.shutdown(grace_secs=1)
+    finally:
+        b.stop()
+    deadline = time.time() + 30
+    eval_path = os.path.join(shared, "eval.json")
+    while not os.path.exists(eval_path) and time.time() < deadline:
+        time.sleep(0.2)
+    with open(eval_path) as f:
+        assert json.load(f)["eval"] == 42.0

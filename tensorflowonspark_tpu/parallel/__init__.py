@@ -19,3 +19,7 @@ from tensorflowonspark_tpu.parallel.collectives import (  # noqa: F401
     all_hosts_agree,
     end_of_data_consensus,
 )
+from tensorflowonspark_tpu.parallel.tp import (  # noqa: F401
+    shard_params,
+    tp_param_shardings,
+)

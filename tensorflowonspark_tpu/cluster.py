@@ -267,7 +267,8 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         tensorboard=False, input_mode=InputMode.FILES, log_dir=None,
         master_node=None, reservation_timeout=600,
         queues=("input", "output", "error"), eval_node=False,
-        release_port=True, profiler=False, executor_env=None):
+        release_port=True, profiler=False, executor_env=None,
+        driver_ps_nodes=False):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -280,6 +281,11 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
       num_ps: number of long-running non-worker ("ps"-like) roles — kept for
         capability parity (reference async-PS mode, SURVEY §2.4); TPU training
         itself is synchronous.
+      driver_ps_nodes: run the ps roles in daemon threads ON THE DRIVER
+        instead of occupying executors (reference ``TFCluster.py:291-309``) —
+        small clusters then spend every executor on workers.  Requires
+        ``num_ps > 0``; the backend only needs ``num_executors - num_ps``
+        task slots.
       master_node: name for the chief role (``None`` → plain ``worker`` 0 is
         chief, reference ``TFCluster.py:225,257-258``).
       eval_node: dedicate one node as ``evaluator`` (reference ``TFCluster.py:228``).
@@ -339,7 +345,29 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                         log_dir=log_dir, queues=tuple(queues),
                         background=background, release_port=release_port,
                         profiler=profiler)
-    start_parts = backend_mod.partition(range(num_executors), num_executors)
+    if driver_ps_nodes:
+        # ps roles run in driver daemon threads (reference
+        # TFCluster.py:291-309): the backend's start job covers only the
+        # worker executors, so every backend slot hosts a worker.
+        assert num_ps > 0, "driver_ps_nodes requires num_ps > 0"
+        start_ids = list(range(num_ps, num_executors))
+        ps_fn = node.run(map_fun, tf_args, cluster_meta, log_dir=log_dir,
+                         queues=tuple(queues), background=background,
+                         release_port=release_port, driver_local=True)
+
+        def _start_driver_ps(node_index):
+            try:
+                ps_fn(iter([node_index]))
+            except Exception:
+                logger.exception("driver-local ps %d failed", node_index)
+
+        for i in cluster_template["ps"]:
+            threading.Thread(target=_start_driver_ps, args=(i,),
+                             name="driver-ps-{}".format(i),
+                             daemon=True).start()
+    else:
+        start_ids = list(range(num_executors))
+    start_parts = [[i] for i in start_ids]
     start_job = cluster_backend.foreach_partition_async(start_parts, start_fn)
 
     # Propagate async start-job failures into the reservation wait (reference

@@ -172,7 +172,7 @@ def _sort_key(node):
 
 def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         queues=("input", "output", "error"), background=False,
-        release_port=True, profiler=False):
+        release_port=True, profiler=False, driver_local=False):
     """Build the "start job" task closure (reference ``TFSparkNode.py:121-368``).
 
     Args:
@@ -187,6 +187,12 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         ``TFSparkNode.py:310-342``).
       release_port: close the reserved coordinator port right before invoking
         ``fn`` (reference ``TFSparkNode.py:306-308``).
+      driver_local: this node runs in a DRIVER thread, not on an executor
+        (``cluster.run(driver_ps_nodes=True)``, reference
+        ``TFCluster.py:291-309``): skip the executor working-dir handshakes
+        (executor-id file, stale-node state file, shm rings) — they belong
+        to executor cwds, and the driver's cwd never receives the shutdown
+        job that would retire a state file.
     """
 
     def _mapfn(iterator):
@@ -219,19 +225,21 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
 
         # Stale-node detection: if this working dir already hosts a live node
         # from another cluster instance, fail loudly so the scheduler retries
-        # elsewhere (reference TFSparkNode.py:166-172).
+        # elsewhere (reference TFSparkNode.py:166-172).  Driver-local nodes
+        # skip the cwd handshakes entirely (see driver_local in run()).
         state_file = os.path.join(os.getcwd(), "cluster_state.json")
-        if os.path.exists(state_file):
-            with open(state_file) as f:
-                prior = json.load(f)
-            if prior.get("cluster_id") != cluster_meta["id"] and prior.get("state") == "running":
-                raise Exception(
-                    "A node from cluster {} appears to still be running in {}; "
-                    "this executor cannot host two clusters. Ensure previous "
-                    "clusters were shut down.".format(prior.get("cluster_id"), os.getcwd())
-                )
+        if not driver_local:
+            if os.path.exists(state_file):
+                with open(state_file) as f:
+                    prior = json.load(f)
+                if prior.get("cluster_id") != cluster_meta["id"] and prior.get("state") == "running":
+                    raise Exception(
+                        "A node from cluster {} appears to still be running in {}; "
+                        "this executor cannot host two clusters. Ensure previous "
+                        "clusters were shut down.".format(prior.get("cluster_id"), os.getcwd())
+                    )
 
-        util.write_executor_id(executor_id)
+            util.write_executor_id(executor_id)
 
         # Start the per-executor manager BEFORE any jax/TPU initialization so
         # the forked manager server never duplicates a live TPU client
@@ -259,10 +267,15 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         # Finalize).  Importing resolves the genuinely process-global module.
         import tensorflowonspark_tpu.node as _node_mod
 
-        _node_mod._node_state["mgr"] = mgr
+        # Keyed by executor id: driver_ps_nodes runs several node closures
+        # in ONE process (driver threads) — a single shared key would drop
+        # all but the last manager's reference.
+        _node_mod._node_state["mgr-{}".format(executor_id)] = mgr
         _node_mod._node_state["cluster_id"] = cluster_meta["id"]
-        with open(state_file, "w") as f:
-            json.dump({"cluster_id": cluster_meta["id"], "state": "running"}, f)
+        if not driver_local:
+            with open(state_file, "w") as f:
+                json.dump({"cluster_id": cluster_meta["id"],
+                           "state": "running"}, f)
 
         # Pre-create the shm-ring feed transports HERE, in the long-lived
         # node process, so the creator's lifetime matches the consumer's.
@@ -270,10 +283,11 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         # created a ring, its exit would unlink it under the consumer and
         # the next feed task would create a second ring with the same name
         # — tokens then promise records that never arrive (the hazard
-        # native/shmring.cc's shmring_free contract documents).
+        # native/shmring.cc's shmring_free contract documents).  Driver-local
+        # ps nodes never receive feed jobs, so no rings.
         from tensorflowonspark_tpu import shmring
 
-        if shmring.available():
+        if shmring.available() and not driver_local:
             # Only feed-direction queues get a ring: results travel back as
             # plain Chunks (DataFeed.batch_results), and error/control carry
             # single small messages.

@@ -295,3 +295,30 @@ def test_evaluator_role_own_world(tmp_path):
         time.sleep(0.2)
     with open(eval_path) as f:
         assert json.load(f)["eval"] == 42.0
+
+
+def test_driver_ps_nodes(local_backend):
+    """driver_ps_nodes parity (reference TFCluster.py:291-309): ps roles run
+    in driver daemon threads, so a 2-executor backend hosts a 3-node cluster
+    (1 ps + 2 workers) with every executor slot spent on a worker."""
+
+    def map_fun(args, ctx):
+        if ctx.job_name == "ps":
+            return  # parked by the node runtime until shutdown
+        feed = ctx.get_data_feed(train_mode=False)
+        while not feed.should_stop():
+            batch = feed.next_batch(3)
+            if batch:
+                feed.batch_results([x + 100 for x in batch])
+
+    c = cluster.run(local_backend, map_fun, tf_args=[], num_executors=3,
+                    num_ps=1, driver_ps_nodes=True,
+                    input_mode=InputMode.SPARK)
+    ps = [n for n in c.cluster_info if n["job_name"] == "ps"]
+    workers = [n for n in c.cluster_info if n["job_name"] == "worker"]
+    assert len(ps) == 1 and len(workers) == 2
+    assert ps[0]["pid"] == os.getpid()          # ps lives on the driver
+    assert all(n["pid"] != os.getpid() for n in workers)
+    results = c.inference(backend.partition(range(12), 4))
+    assert sorted(results) == [x + 100 for x in range(12)]
+    c.shutdown(grace_secs=1)

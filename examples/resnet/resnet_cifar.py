@@ -64,7 +64,15 @@ def main_fun(args, ctx):
     shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
     images, labels = images[shard], labels[shard]
 
-    model = resnet_mod.build_resnet56(dtype=args.dtype)
+    if args.blocks_per_stage != 9:
+        # size knob (the reference's resnet_size, resnet_cifar_main.py):
+        # 6n+2 layers; 9 -> ResNet-56, 1 -> an 8-layer smoke model.
+        model = resnet_mod.ResNet(
+            stage_sizes=[args.blocks_per_stage] * 3,
+            block_cls=resnet_mod.BasicBlock, num_classes=NUM_CLASSES,
+            num_filters=16, dtype=jnp.dtype(args.dtype), cifar_stem=True)
+    else:
+        model = resnet_mod.build_resnet56(dtype=args.dtype)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, HEIGHT, WIDTH, CHANNELS)),
                            train=False)
@@ -165,6 +173,9 @@ def main(argv=None):
     parser.add_argument("--train_steps", type=int, default=None,
                         help="overrides train_epochs when set")
     parser.add_argument("--base_lr", type=float, default=0.1)
+    parser.add_argument("--blocks_per_stage", type=int, default=9,
+                        help="basic blocks per stage: 6n+2 layers (9 = "
+                             "ResNet-56; the reference's resnet_size knob)")
     parser.add_argument("--weight_decay", type=float, default=2e-4)
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["float32", "bfloat16"])

@@ -70,8 +70,18 @@ def main_fun(args, ctx):
     shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
     images, labels = images[shard], labels[shard]
 
-    model = resnet_mod.build_resnet50(num_classes=NUM_CLASSES,
-                                      dtype=args.dtype)
+    if args.blocks_per_stage:
+        # size knob (the reference's resnet_size, resnet_run_loop.py):
+        # N bottleneck blocks per stage; 1 -> a 14-layer smoke model.
+        import jax.numpy as _jnp
+
+        model = resnet_mod.ResNet(
+            stage_sizes=[args.blocks_per_stage] * 4,
+            block_cls=resnet_mod.BottleneckBlock,
+            num_classes=NUM_CLASSES, dtype=_jnp.dtype(args.dtype))
+    else:
+        model = resnet_mod.build_resnet50(num_classes=NUM_CLASSES,
+                                          dtype=args.dtype)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, size, size, 3)), train=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
@@ -170,6 +180,9 @@ def main(argv=None):
     parser.add_argument("--train_steps", type=int, default=None,
                         help="overrides train_epochs when set")
     parser.add_argument("--image_size", type=int, default=DEFAULT_IMAGE_SIZE)
+    parser.add_argument("--blocks_per_stage", type=int, default=None,
+                        help="bottleneck blocks per stage (None = ResNet-50's "
+                             "[3,4,6,3]; the reference's resnet_size knob)")
     parser.add_argument("--base_lr", type=float, default=0.1)
     parser.add_argument("--weight_decay", type=float, default=1e-4)
     parser.add_argument("--label_smoothing", type=float, default=0.1,

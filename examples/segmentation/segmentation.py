@@ -59,7 +59,9 @@ def main_fun(args, ctx):
     shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
     images, masks = images[shard], masks[shard]
 
-    model = unet_mod.build_unet(num_classes=3, dtype=args.dtype)
+    filters = tuple(int(f) for f in args.encoder_filters.split(","))
+    model = unet_mod.build_unet(num_classes=3, dtype=args.dtype,
+                                encoder_filters=filters)
     params = model.init(
         jax.random.PRNGKey(0),
         jnp.zeros((1, args.image_size, args.image_size, 3)))["params"]
@@ -112,6 +114,9 @@ def main(argv=None):
     parser.add_argument("--train_steps", type=int, default=200)
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--image_size", type=int, default=128)
+    parser.add_argument("--encoder_filters", default="32,64,128,256",
+                        help="comma-separated U-Net encoder widths (depth "
+                             "knob; fewer/narrower stages for smoke tests)")
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["float32", "bfloat16"])
     parser.add_argument("--synthetic_examples", type=int, default=512)

@@ -10,8 +10,11 @@
 # jax.distributed worlds.
 #
 # Usage:
-#   ./run_tests.sh            # full suite
-#   ./run_tests.sh -m 'not slow'   # fast subset (skip pipeline e2e etc.)
+#   ./run_tests.sh            # full suite (~12 min on 8 CPU cores)
+#   ./run_tests.sh -m 'not slow'   # fast subset, ~2:45 — every framework
+#                                  # module; 'slow' marks the example/cluster
+#                                  # integration runs (each boots multi-
+#                                  # process clusters in subprocesses)
 #   ./run_tests.sh tests/test_cluster.py   # one file
 set -euo pipefail
 cd "$(dirname "$0")"

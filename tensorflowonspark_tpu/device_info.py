@@ -62,6 +62,13 @@ def pin_chips(worker_index, chips_per_worker, total_chips=4):
     Must be called before JAX initializes; only manipulates env vars
     (``TPU_VISIBLE_CHIPS``, ``TPU_CHIPS_PER_PROCESS_BOUNDS``,
     ``TPU_PROCESS_BOUNDS``).
+
+    **Validation status**: the env-var arithmetic is unit-tested, but this
+    has never run against a real multi-chip TPU host (the dev image exposes
+    a single tunneled chip).  The defaults (``total_chips=4``, the
+    ``"1,1,1"`` bounds) follow the published libtpu multi-process-per-host
+    conventions for v4/v5e boards — verify on your topology before relying
+    on them in production.
     """
     if "jax" in sys.modules:
         import jax

@@ -170,6 +170,34 @@ class ModelServer(object):
             return [(None, t) for t in sorted(self.signature)]
         return [(None, None)]  # unnamed single input
 
+    def _feed_dict_single(self, rows, input_mapping, dict_rows):
+        """Single-input feed: ALL mapped columns (or all row fields)
+        assemble positionally into the one input tensor, whatever the
+        mapping calls it — the reference's placeholder pattern where N
+        scalar DataFrame columns form one input vector (old
+        ``pipeline.py:489-502`` flattened the whole row the same way)."""
+        tensor = next(iter(self.signature)) if self.signature else None
+        cols = sorted(input_mapping) if input_mapping else None
+        if dict_rows:
+            if cols is None:
+                if tensor and tensor in rows[0]:
+                    cols = [tensor]   # column named after the tensor
+                elif len(rows[0]) == 1:
+                    cols = [next(iter(rows[0]))]
+                else:
+                    raise ValueError(
+                        "dict rows with columns {} need an input_mapping "
+                        "naming the input column(s) (no column matches the "
+                        "signature tensor {!r})".format(
+                            sorted(rows[0]), tensor))
+            if len(cols) == 1:
+                vals = [r[cols[0]] for r in rows]
+            else:
+                vals = [[r[c] for c in cols] for r in rows]
+        else:
+            vals = rows   # positional: the whole row is the input
+        return {tensor or "_x": self._coerce(tensor, vals)}
+
     def _coerce(self, tensor, col):
         """Apply the signature's dtype/shape to one input column."""
         spec = None
@@ -189,32 +217,17 @@ class ModelServer(object):
             x = x.reshape([-1] + list(spec["shape"][1:]))
         return x
 
-    def _feed_dict(self, rows, spec):
+    def _feed_dict(self, rows, spec, input_mapping=None):
         """Build ``{tensor: array}`` from a batch of rows.
 
-        Dict rows are read by column name (CLI path; needs the mapping's
-        column binding); tuple rows positionally in sorted-column order
-        (pipeline path); bare values feed a single input directly.
+        Single-input signatures assemble all columns/fields into the one
+        tensor (:meth:`_feed_dict_single`).  Multi-input signatures bind
+        strictly per tensor: dict rows by column name (CLI path), tuple
+        rows positionally in sorted-column order (pipeline path).
         """
         dict_rows = bool(rows) and isinstance(rows[0], dict)
-        if len(spec) == 1:
-            column, tensor = spec[0]
-            if dict_rows:
-                if column is None:
-                    if len(rows[0]) == 1:
-                        column = next(iter(rows[0]))
-                    elif tensor and tensor in rows[0]:
-                        column = tensor  # unmapped: column named after tensor
-                    else:
-                        raise ValueError(
-                            "dict rows with columns {} need an input_mapping "
-                            "naming the input column (no column matches the "
-                            "signature tensor {!r})".format(
-                                sorted(rows[0]), tensor))
-                vals = [r[column] for r in rows]
-            else:
-                vals = rows
-            return {tensor or "_x": self._coerce(tensor, vals)}
+        if len(self.signature) <= 1:
+            return self._feed_dict_single(rows, input_mapping, dict_rows)
         if not dict_rows and rows and len(rows[0]) != len(spec):
             # Positional feeding with mismatched arity would silently bind
             # the wrong columns to tensors — wrong predictions, no error.
@@ -254,7 +267,8 @@ class ModelServer(object):
 
         spec = self._feed_spec(input_mapping)
         for rows, count in yield_batch(iterator, self.batch_size):
-            outputs = self.predict_feed(self._feed_dict(rows, spec), count)
+            outputs = self.predict_feed(
+                self._feed_dict(rows, spec, input_mapping), count)
             cols = output_columns(output_mapping, outputs,
                                   allow_unmapped_multi=False)
             series = [outputs[t] for t, _ in cols]
@@ -272,7 +286,8 @@ class ModelServer(object):
 
         spec = self._feed_spec(input_mapping)
         for rows, count in yield_batch(iterator, self.batch_size):
-            outputs = self.predict_feed(self._feed_dict(rows, spec), count)
+            outputs = self.predict_feed(
+                self._feed_dict(rows, spec, input_mapping), count)
             cols = output_columns(output_mapping, outputs)
             for i in range(count):
                 out = dict(rows[i]) if isinstance(rows[i], dict) else {}

@@ -19,6 +19,19 @@ semantics that matter for those paths:
 It is a test double, not a Spark: no shuffle, no storage levels, no SQL.
 Production code must only use documented pyspark APIs so the same code runs
 against the real thing.
+
+**Fidelity caveat (read before trusting green Spark tests).**  This shim
+was written by the same hand as the code under test, so it can only catch
+contract violations the author anticipated.  Known gaps vs a real
+``local-cluster``: py4j serialization quirks (shim tasks cloudpickle
+directly), real scheduler placement/retry behavior, ``pyspark.ml``'s full
+Param/uid plumbing, SQL type coercion in DataFrames, and JVM-side
+``hadoopConfiguration``.  The reference validated against a live 2-worker
+Spark Standalone cluster (reference ``test/run_tests.sh:15-22``); this
+image ships no JVM or pyspark, so that rig cannot run here.  When pyspark
+IS installed, ``tests/test_spark.py`` auto-prefers the real package (the
+shim only installs itself if ``import pyspark`` fails) — run the suite in
+such an environment before claiming real-Spark compatibility.
 """
 
 import os

@@ -27,6 +27,7 @@ def run_example(rel, argv, timeout=280):
     return proc.stdout + proc.stderr
 
 
+@pytest.mark.slow
 def test_mnist_spark_trains_and_exports(tmp_path):
     export = str(tmp_path / "export")
     out = run_example("mnist/mnist_spark.py",
@@ -36,6 +37,7 @@ def test_mnist_spark_trains_and_exports(tmp_path):
     assert os.path.exists(os.path.join(export, "export.json"))
 
 
+@pytest.mark.slow
 def test_mnist_files_checkpoint_and_inference(tmp_path):
     export = str(tmp_path / "export")
     out = run_example("mnist/mnist_files.py",
@@ -50,6 +52,7 @@ def test_mnist_files_checkpoint_and_inference(tmp_path):
     assert "accuracy:" in out
 
 
+@pytest.mark.slow
 def test_mnist_streaming_bounded(tmp_path):
     out = run_example("mnist/mnist_streaming.py",
                       ["--cluster_size", "2", "--max_batches", "4",
@@ -57,22 +60,27 @@ def test_mnist_streaming_bounded(tmp_path):
     assert "train stats" in out
 
 
+@pytest.mark.slow
 def test_resnet_cifar_synthetic():
     out = run_example("resnet/resnet_cifar.py",
                       ["--cluster_size", "2", "--use_synthetic_data",
                        "--train_steps", "2", "--batch_size", "32",
+                       "--blocks_per_stage", "1",     # ResNet-8: compile fast
                        "--synthetic_examples", "64"])
     assert "train stats" in out
 
 
+@pytest.mark.slow
 def test_segmentation_synthetic():
     out = run_example("segmentation/segmentation.py",
                       ["--cluster_size", "2", "--train_steps", "2",
                        "--batch_size", "16", "--image_size", "32",
+                       "--encoder_filters", "16,32",  # shallow: compile fast
                        "--synthetic_examples", "64"])
     assert "train stats" in out
 
 
+@pytest.mark.slow
 def test_transformer_lm_3d_mesh():
     out = run_example("transformer/transformer_lm.py",
                       ["--cluster_size", "1", "--data", "2", "--seq", "2",
@@ -82,6 +90,7 @@ def test_transformer_lm_3d_mesh():
     assert "train stats" in out
 
 
+@pytest.mark.slow
 def test_mnist_data_setup_roundtrip(tmp_path):
     run_example("mnist/mnist_data_setup.py",
                 ["--output", str(tmp_path), "--num_partitions", "2"],
@@ -103,9 +112,11 @@ def test_mnist_pipeline_end_to_end():
     assert "pipeline accuracy" in out
 
 
+@pytest.mark.slow
 def test_resnet_imagenet_synthetic():
     out = run_example("resnet/resnet_imagenet.py",
                       ["--cluster_size", "2", "--use_synthetic_data",
                        "--train_steps", "2", "--batch_size", "16",
+                       "--blocks_per_stage", "1",     # 14-layer: compile fast
                        "--image_size", "64", "--synthetic_examples", "64"])
     assert "train stats" in out

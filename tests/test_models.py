@@ -48,6 +48,7 @@ class TestMnist:
 
 
 class TestResNet:
+    @pytest.mark.slow
     def test_resnet56_cifar_forward(self):
         model = models.get_model("resnet56_cifar")
         variables = model.init(jax.random.PRNGKey(0),
@@ -56,6 +57,7 @@ class TestResNet:
         assert logits.shape == (2, 10)
         assert "batch_stats" in variables
 
+    @pytest.mark.slow
     def test_resnet50_forward_tiny(self):
         model = models.get_model("resnet50", num_classes=5, dtype="float32")
         variables = model.init(jax.random.PRNGKey(0),
@@ -63,6 +65,7 @@ class TestResNet:
         logits = model.apply(variables, jnp.ones((1, 64, 64, 3)))
         assert logits.shape == (1, 5)
 
+    @pytest.mark.slow
     def test_train_step_updates_batch_stats(self):
         mesh = build_mesh()
         model = models.get_model("resnet56_cifar")
@@ -86,6 +89,7 @@ class TestResNet:
 
 
 class TestUnet:
+    @pytest.mark.slow
     def test_forward_and_loss(self):
         mesh = build_mesh()
         model = models.get_model("unet", num_classes=3)

@@ -125,6 +125,7 @@ def _train_fn(args, ctx):
             input_signature={"x": [None, 2]})
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("np_", [np])  # keep fixture-free structure flat
 def test_fit_transform_end_to_end(tmp_path, np_):
     b = backend.LocalBackend(2)
@@ -197,6 +198,7 @@ def _twotower_train_fn(args, ctx):
             model=model)
 
 
+@pytest.mark.slow
 def test_multi_input_multi_output_fit_transform(tmp_path):
     """2-input / 2-output parity (reference pipeline.py:469-518 /
     TFModel.scala:51-239): fit a two-tower model, then transform with an

@@ -64,15 +64,10 @@ def main_fun(args, ctx):
     shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
     images, labels = images[shard], labels[shard]
 
-    if args.blocks_per_stage != 9:
-        # size knob (the reference's resnet_size, resnet_cifar_main.py):
-        # 6n+2 layers; 9 -> ResNet-56, 1 -> an 8-layer smoke model.
-        model = resnet_mod.ResNet(
-            stage_sizes=[args.blocks_per_stage] * 3,
-            block_cls=resnet_mod.BasicBlock, num_classes=NUM_CLASSES,
-            num_filters=16, dtype=jnp.dtype(args.dtype), cifar_stem=True)
-    else:
-        model = resnet_mod.build_resnet56(dtype=args.dtype)
+    # blocks_per_stage is the size knob (reference resnet_size): 6n+2
+    # layers; 9 -> ResNet-56, 1 -> an 8-layer smoke model.
+    model = resnet_mod.build_resnet56(dtype=args.dtype,
+                                      blocks_per_stage=args.blocks_per_stage)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, HEIGHT, WIDTH, CHANNELS)),
                            train=False)
@@ -156,7 +151,8 @@ def main_fun(args, ctx):
         checkpoint.export_model(
             ctx.absolute_path(args.export_dir),
             jax.device_get(trainer.state.params), "resnet56_cifar",
-            model_config={"dtype": args.dtype},
+            model_config={"dtype": args.dtype,
+                          "blocks_per_stage": args.blocks_per_stage},
             input_signature={"image": [None, HEIGHT, WIDTH, CHANNELS]})
     return stats
 

@@ -70,18 +70,11 @@ def main_fun(args, ctx):
     shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
     images, labels = images[shard], labels[shard]
 
-    if args.blocks_per_stage:
-        # size knob (the reference's resnet_size, resnet_run_loop.py):
-        # N bottleneck blocks per stage; 1 -> a 14-layer smoke model.
-        import jax.numpy as _jnp
-
-        model = resnet_mod.ResNet(
-            stage_sizes=[args.blocks_per_stage] * 4,
-            block_cls=resnet_mod.BottleneckBlock,
-            num_classes=NUM_CLASSES, dtype=_jnp.dtype(args.dtype))
-    else:
-        model = resnet_mod.build_resnet50(num_classes=NUM_CLASSES,
-                                          dtype=args.dtype)
+    # blocks_per_stage is the size knob (the reference's resnet_size):
+    # None -> ResNet-50's [3,4,6,3]; 1 -> a 14-layer smoke model.
+    model = resnet_mod.build_resnet50(num_classes=NUM_CLASSES,
+                                      dtype=args.dtype,
+                                      blocks_per_stage=args.blocks_per_stage)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, size, size, 3)), train=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
@@ -163,7 +156,8 @@ def main_fun(args, ctx):
         checkpoint.export_model(
             ctx.absolute_path(args.export_dir),
             jax.device_get(trainer.state.params), "resnet50",
-            model_config={"num_classes": NUM_CLASSES, "dtype": args.dtype},
+            model_config={"num_classes": NUM_CLASSES, "dtype": args.dtype,
+                          "blocks_per_stage": args.blocks_per_stage},
             input_signature={"image": [None, size, size, 3]})
     return stats
 

@@ -219,6 +219,16 @@ class LocalBackend(object):
         def _dispatch():
             threads = []
             for task_id, items in enumerate(partitions):
+                if handle.error is not None:
+                    # Job-level cancel: a sibling task already failed, so
+                    # don't keep feeding the failed job's remaining tasks to
+                    # executors (wait() has raised; stop() may be imminent).
+                    # In-flight tasks finish on their own.
+                    handle._task_done(
+                        task_id, False,
+                        "task skipped: job cancelled after an earlier task "
+                        "failure")
+                    continue
                 executor_index = self._free.get()  # blocks until a slot frees up
                 if self._stopped:
                     handle._task_done(task_id, False, "backend stopped")

@@ -116,16 +116,26 @@ class ResNet(nn.Module):
 
 
 @register_model("resnet50")
-def build_resnet50(num_classes=1000, dtype="bfloat16"):
-    """ResNet50 v1.5 for ImageNet (reference ``resnet_imagenet_main.py``)."""
-    return ResNet(stage_sizes=[3, 4, 6, 3], block_cls=BottleneckBlock,
+def build_resnet50(num_classes=1000, dtype="bfloat16", blocks_per_stage=None):
+    """ResNet50 v1.5 for ImageNet (reference ``resnet_imagenet_main.py``).
+
+    ``blocks_per_stage`` is the size knob (the reference's ``resnet_size``):
+    None = the [3,4,6,3] ResNet-50; N = [N,N,N,N] bottleneck stages.  Part
+    of the registry signature so exports of custom-depth models rebuild
+    correctly from their descriptor."""
+    stage_sizes = ([blocks_per_stage] * 4 if blocks_per_stage
+                   else [3, 4, 6, 3])
+    return ResNet(stage_sizes=stage_sizes, block_cls=BottleneckBlock,
                   num_classes=num_classes, dtype=jnp.dtype(dtype))
 
 
 @register_model("resnet56_cifar")
-def build_resnet56(num_classes=10, dtype="float32"):
-    """ResNet56 for CIFAR-10 (reference ``resnet_cifar_main.py``)."""
-    return ResNet(stage_sizes=[9, 9, 9], block_cls=BasicBlock,
+def build_resnet56(num_classes=10, dtype="float32", blocks_per_stage=9):
+    """ResNet56 for CIFAR-10 (reference ``resnet_cifar_main.py``).
+
+    ``blocks_per_stage``: 6n+2 layers; 9 = ResNet-56 (size knob in the
+    registry signature so custom-depth exports rebuild correctly)."""
+    return ResNet(stage_sizes=[blocks_per_stage] * 3, block_cls=BasicBlock,
                   num_classes=num_classes, num_filters=16, cifar_stem=True,
                   dtype=jnp.dtype(dtype))
 

@@ -23,3 +23,9 @@ from tensorflowonspark_tpu.parallel.tp import (  # noqa: F401
     shard_params,
     tp_param_shardings,
 )
+from tensorflowonspark_tpu.parallel.pp import (  # noqa: F401
+    gpipe,
+    split_microbatches,
+    stack_stage_params,
+    stage_shardings,
+)

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""TPU pod/VM provisioning CLI (the role of the reference's
+``scripts/spark_ec2.py`` EC2 launcher, rebuilt for Cloud TPU).
+
+Wraps ``gcloud compute tpus`` the way spark_ec2 wrapped boto: launch,
+inspect, address, drive, and tear down the accelerator fleet a cluster runs
+on — with the framework's conventions baked in (one worker process per TPU
+host, env staged before the first jax import, code pushed to every host).
+
+Subcommands:
+  create   — create a TPU VM / pod slice (``--queued`` uses queued
+             resources for capacity that isn't immediately available)
+  delete   — tear the slice down (and its queued-resource handle)
+  status   — describe state, health, and per-host internal/external IPs
+  hosts    — print the worker host list (feeds ``cluster.run`` deployments)
+  ssh      — run a command on one worker or --worker=all (the pod idiom)
+  scp      — push files/trees to every worker
+  launch   — stage a working dir + env to all workers and start one
+             framework node process per host
+
+Every gcloud invocation goes through one chokepoint (:func:`gcloud_cmd`);
+``--dry_run`` prints commands instead of executing, which is also how the
+unit tests validate command assembly without gcloud installed.
+
+Example — an 8-host v5e-64 slice running the MNIST example:
+
+    python scripts/tpu_pod.py create --name tfos --zone us-west4-a \\
+        --accelerator v5litepod-64 --version v2-alpha-tpuv5-lite
+    python scripts/tpu_pod.py launch --name tfos --zone us-west4-a \\
+        --workdir . --entry examples/mnist/mnist_spark.py -- --epochs 3
+"""
+
+import argparse
+import json
+import shlex
+import subprocess
+import sys
+
+DEFAULT_VERSION = "tpu-ubuntu2204-base"
+
+
+def gcloud_cmd(args, dry_run=False, capture=False):
+    """Run (or print) one gcloud command; the single execution chokepoint."""
+    cmd = ["gcloud"] + args
+    if dry_run:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return ""
+    proc = subprocess.run(cmd, text=True,
+                          capture_output=capture, check=True)
+    return proc.stdout if capture else ""
+
+
+def _base(ns):
+    return ["compute", "tpus", "tpu-vm"]
+
+
+def cmd_create(ns):
+    """Create a TPU VM/slice; ``--queued`` files a queued resource instead
+    (capacity that isn't immediately grantable, the modern reservation
+    path)."""
+    if ns.queued:
+        args = ["compute", "tpus", "queued-resources", "create", ns.name,
+                "--node-id", ns.name,
+                "--zone", ns.zone,
+                "--accelerator-type", ns.accelerator,
+                "--runtime-version", ns.version]
+        if ns.spot:
+            args.append("--spot")
+        if ns.reserved:
+            args.append("--reserved")
+    else:
+        args = _base(ns) + ["create", ns.name,
+                            "--zone", ns.zone,
+                            "--accelerator-type", ns.accelerator,
+                            "--version", ns.version]
+        if ns.spot:
+            args.append("--spot")
+    if ns.network:
+        args += ["--network", ns.network]
+    if ns.tags:
+        args += ["--tags", ns.tags]
+    if ns.metadata:
+        args += ["--metadata", ns.metadata]
+    return gcloud_cmd(args, ns.dry_run)
+
+
+def cmd_delete(ns):
+    args = _base(ns) + ["delete", ns.name, "--zone", ns.zone, "--quiet"]
+    out = gcloud_cmd(args, ns.dry_run)
+    if ns.queued:
+        out += gcloud_cmd(
+            ["compute", "tpus", "queued-resources", "delete", ns.name,
+             "--zone", ns.zone, "--quiet", "--force"], ns.dry_run)
+    return out
+
+
+def describe(ns):
+    out = gcloud_cmd(_base(ns) + ["describe", ns.name, "--zone", ns.zone,
+                                  "--format", "json"],
+                     ns.dry_run, capture=True)
+    return json.loads(out) if out else {}
+
+
+def cmd_status(ns):
+    info = describe(ns)
+    if not info:
+        return  # dry run
+    print("name:    {}".format(info.get("name", ns.name)))
+    print("state:   {}".format(info.get("state")))
+    print("health:  {}".format(info.get("health", "UNKNOWN")))
+    print("type:    {}".format(info.get("acceleratorType")))
+    for i, ep in enumerate(info.get("networkEndpoints", [])):
+        ext = (ep.get("accessConfig") or {}).get("externalIp", "-")
+        print("worker {}: internal {} external {}".format(
+            i, ep.get("ipAddress"), ext))
+
+
+def cmd_hosts(ns):
+    """Internal IPs, one per line — feed these to your scheduler/backends;
+    host 0 is the jax.distributed coordinator by convention."""
+    info = describe(ns)
+    for ep in info.get("networkEndpoints", []):
+        print(ep.get("ipAddress"))
+
+
+def cmd_ssh(ns, command=None):
+    args = _base(ns) + ["ssh", ns.name, "--zone", ns.zone,
+                        "--worker", ns.worker]
+    cmd = command if command is not None else ns.command
+    if cmd:
+        args += ["--command", cmd]
+    return gcloud_cmd(args, ns.dry_run)
+
+
+def cmd_scp(ns, src=None, dst=None):
+    args = _base(ns) + ["scp", "--recurse",
+                        src or ns.src,
+                        "{}:{}".format(ns.name, dst or ns.dst),
+                        "--zone", ns.zone, "--worker", ns.worker]
+    return gcloud_cmd(args, ns.dry_run)
+
+
+def cmd_launch(ns):
+    """Stage the working dir to every host and start one framework node
+    process per host — the per-TPU-host process granularity the framework
+    assumes (SURVEY §7.2).  Host 0's address becomes the coordinator."""
+    remote_dir = ns.remote_dir
+    cmd_scp(ns, src=ns.workdir, dst=remote_dir)
+    env = " ".join(ns.env or [])
+    extra = " ".join(shlex.quote(a) for a in (ns.extra or []))
+    launch = ("cd {d} && {env} nohup python {entry} {extra} "
+              "> {d}/node.log 2>&1 &").format(
+                  d=remote_dir, env=env, entry=ns.entry, extra=extra)
+    return cmd_ssh(ns, command=launch)
+
+
+def build_parser():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--dry_run", action="store_true",
+                   help="print gcloud commands instead of executing")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--name", required=True)
+        sp.add_argument("--zone", required=True)
+
+    sp = sub.add_parser("create", help="create a TPU VM / pod slice")
+    common(sp)
+    sp.add_argument("--accelerator", required=True,
+                    help="e.g. v5litepod-8, v4-32")
+    sp.add_argument("--version", default=DEFAULT_VERSION,
+                    help="TPU runtime version image")
+    sp.add_argument("--queued", action="store_true",
+                    help="file a queued resource instead of direct create")
+    sp.add_argument("--spot", action="store_true")
+    sp.add_argument("--reserved", action="store_true")
+    sp.add_argument("--network", default=None)
+    sp.add_argument("--tags", default=None)
+    sp.add_argument("--metadata", default=None)
+    sp.set_defaults(fn=cmd_create)
+
+    sp = sub.add_parser("delete", help="delete the slice")
+    common(sp)
+    sp.add_argument("--queued", action="store_true",
+                    help="also delete the queued-resource handle")
+    sp.set_defaults(fn=cmd_delete)
+
+    sp = sub.add_parser("status", help="state/health/per-host IPs")
+    common(sp)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("hosts", help="print worker internal IPs")
+    common(sp)
+    sp.set_defaults(fn=cmd_hosts)
+
+    sp = sub.add_parser("ssh", help="run a command on worker(s)")
+    common(sp)
+    sp.add_argument("--worker", default="all",
+                    help='worker index or "all" (default)')
+    sp.add_argument("--command", default=None)
+    sp.set_defaults(fn=cmd_ssh)
+
+    sp = sub.add_parser("scp", help="push files to worker(s)")
+    common(sp)
+    sp.add_argument("--worker", default="all")
+    sp.add_argument("src")
+    sp.add_argument("dst")
+    sp.set_defaults(fn=cmd_scp)
+
+    sp = sub.add_parser("launch", help="stage workdir + start node per host")
+    common(sp)
+    sp.add_argument("--worker", default="all")
+    sp.add_argument("--workdir", default=".")
+    sp.add_argument("--remote_dir", default="~/tfos")
+    sp.add_argument("--entry", required=True,
+                    help="driver/node script relative to workdir")
+    sp.add_argument("--env", action="append", default=[],
+                    help="KEY=VALUE exported before the entry (repeatable); "
+                         "set TPU/XLA knobs here — they must precede the "
+                         "first jax import")
+    sp.add_argument("extra", nargs="*",
+                    help="arguments after -- pass through to the entry")
+    sp.set_defaults(fn=cmd_launch)
+    return p
+
+
+def main(argv=None):
+    ns = build_parser().parse_args(argv)
+    try:
+        ns.fn(ns)
+    except subprocess.CalledProcessError as e:
+        print("gcloud failed (rc={}): {}".format(e.returncode, e), file=sys.stderr)
+        return e.returncode
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+"""Provisioning CLI tests (reference ``scripts/spark_ec2.py`` role):
+validate gcloud command assembly via --dry_run — no gcloud needed."""
+
+import os
+import subprocess
+import sys
+
+SCRIPT = os.path.join(os.path.dirname(__file__), "..", "scripts", "tpu_pod.py")
+
+
+def run_cli(argv):
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--dry_run"] + argv,
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout.strip().splitlines()
+
+
+def test_create_direct():
+    (cmd,) = run_cli(["create", "--name", "tfos", "--zone", "us-west4-a",
+                      "--accelerator", "v5litepod-8"])
+    assert cmd.startswith("gcloud compute tpus tpu-vm create tfos")
+    assert "--accelerator-type v5litepod-8" in cmd
+    assert "--zone us-west4-a" in cmd
+
+
+def test_create_queued_resource():
+    (cmd,) = run_cli(["create", "--name", "tfos", "--zone", "us-west4-a",
+                      "--accelerator", "v4-32", "--queued", "--spot"])
+    assert "queued-resources create tfos" in cmd
+    assert "--node-id tfos" in cmd and "--spot" in cmd
+
+
+def test_delete_with_queued_handle():
+    cmds = run_cli(["delete", "--name", "tfos", "--zone", "z", "--queued"])
+    assert len(cmds) == 2
+    assert "tpu-vm delete tfos" in cmds[0] and "--quiet" in cmds[0]
+    assert "queued-resources delete tfos" in cmds[1]
+
+
+def test_ssh_all_workers():
+    (cmd,) = run_cli(["ssh", "--name", "tfos", "--zone", "z",
+                      "--command", "hostname"])
+    assert "--worker all" in cmd and "--command hostname" in cmd
+
+
+def test_launch_stages_and_starts():
+    cmds = run_cli(["launch", "--name", "tfos", "--zone", "z",
+                    "--workdir", ".", "--entry", "examples/mnist/mnist_spark.py",
+                    "--env", "JAX_PLATFORMS=tpu",
+                    "--", "--epochs", "3"])
+    assert len(cmds) == 2
+    assert "scp --recurse ." in cmds[0] and "tfos:~/tfos" in cmds[0]
+    assert "JAX_PLATFORMS=tpu" in cmds[1]
+    assert "mnist_spark.py" in cmds[1] and "--epochs 3" in cmds[1]

@@ -166,9 +166,14 @@ def should_export(ctx):
 _STABLEHLO_FILE = "apply.stablehlo"
 
 
+_EMBEDDED_MLIR_FILE = "apply_embedded.mlir"
+_COMPILE_OPTIONS_FILE = "compile_options.pb"
+
+
 def export_model(export_dir, params, model_name, model_config=None,
                  input_signature=None, model=None,
-                 serialize_platforms=("cpu", "tpu")):
+                 serialize_platforms=("cpu", "tpu"),
+                 embed_batch_size=None, embed_platform="tpu"):
     """Export params + model descriptor for serving.
 
     Call according to :func:`should_export` (chief-only convention,
@@ -184,6 +189,11 @@ def export_model(export_dir, params, model_name, model_config=None,
     reference's user-code-free SavedModel/JNI path,
     ``TFModel.scala:245-292``).  Registry-based serving remains the
     fallback whenever the artifact is absent or platform-mismatched.
+
+    ``embed_batch_size`` additionally writes a **params-embedded**,
+    fixed-batch StableHLO module (+ serialized compile options) for the
+    native C++ PJRT runner (``native/pjrt_runner.cc``) — serving with no
+    Python at all; ``embed_platform`` picks its single lowering target.
     """
     import jax
     import orbax.checkpoint as ocp
@@ -215,6 +225,23 @@ def export_model(export_dir, params, model_name, model_config=None,
             # The orbax+registry path still serves; don't fail the export.
             logger.warning("StableHLO serialization failed; export remains "
                            "registry-served", exc_info=True)
+        if embed_batch_size:
+            try:
+                mlir, options, meta = serving.serialize_embedded(
+                    model, jax.device_get(params), input_signature,
+                    batch_size=embed_batch_size, platform=embed_platform)
+                with open(os.path.join(export_dir, _EMBEDDED_MLIR_FILE),
+                          "wb") as f:
+                    f.write(mlir)
+                with open(os.path.join(export_dir, _COMPILE_OPTIONS_FILE),
+                          "wb") as f:
+                    f.write(options)
+                meta["file"] = _EMBEDDED_MLIR_FILE
+                meta["options_file"] = _COMPILE_OPTIONS_FILE
+                descriptor["embedded_mlir"] = meta
+            except Exception:
+                logger.warning("embedded-MLIR serialization failed; native "
+                               "runner artifact omitted", exc_info=True)
     if jax.process_index() == 0:
         with open(os.path.join(export_dir, _DESCRIPTOR), "w") as f:
             json.dump(descriptor, f)

@@ -22,6 +22,68 @@ _lock = threading.Lock()
 _cache = {}
 
 
+def _compile(src, out, flags, timeout):
+    """Compile ``src`` -> ``out`` when missing/stale.  Compiles to a private
+    temp file, then atomically renames: many executor processes race this
+    build on one host, and dlopen/exec of a half-written binary would
+    permanently demote that process to its fallback path."""
+    stale = (not os.path.exists(out)
+             or os.path.getmtime(out) < os.path.getmtime(src))
+    if not stale:
+        return
+    tmp = "{}.tmp.{}".format(out, os.getpid())
+    cmd = ["g++", "-O3", "-std=c++17"] + list(flags) + ["-o", tmp, src]
+    logger.info("building native code: %s", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
+    os.replace(tmp, out)
+
+
+def build_executable(name, include_dirs=(), libs=("dl",), timeout=240):
+    """Build ``native/<name>.cc`` into the executable ``native/<name>``,
+    returning its path (cached; rebuilt when the source is newer) or None
+    when the toolchain/headers are unavailable.
+
+    Used for the PJRT serving runner, whose only header dependency
+    (``pjrt_c_api.h``) ships inside installed accelerator wheels — pass the
+    wheel's include dir via ``include_dirs``.
+    """
+    key = ("exe", name)
+    with _lock:
+        if key in _cache:
+            return _cache[key]
+        out = None
+        try:
+            src = os.path.join(_NATIVE_DIR, name + ".cc")
+            exe = os.path.join(_NATIVE_DIR, name)
+            if os.path.exists(src):
+                flags = (["-I" + d for d in include_dirs]
+                         + ["-l" + l for l in libs])
+                _compile(src, exe, flags, timeout)
+                out = exe
+        except Exception:
+            logger.warning("native executable %s unavailable", name,
+                           exc_info=True)
+            out = None
+        _cache[key] = out
+        return out
+
+
+def pjrt_include_dirs():
+    """Best-effort include dirs carrying ``pjrt_c_api.h`` from installed
+    wheels (tensorflow ships the XLA headers in this image)."""
+    dirs = []
+    try:
+        import tensorflow as _tf  # noqa: F401  (heavy: only for its path)
+
+        dirs.append(os.path.join(os.path.dirname(_tf.__file__), "include"))
+    except Exception:
+        pass
+    return [d for d in dirs
+            if os.path.exists(os.path.join(
+                d, "tensorflow", "compiler", "xla", "pjrt", "c",
+                "pjrt_c_api.h"))]
+
+
 def load(name, sources=None):
     """Load ``lib<name>.so``, building it from ``native/<name>.cc`` first if
     missing or stale; returns a ``ctypes.CDLL`` or None on any failure."""
@@ -33,20 +95,7 @@ def load(name, sources=None):
             src = os.path.join(_NATIVE_DIR, (sources or name + ".cc"))
             so = os.path.join(_NATIVE_DIR, "lib{}.so".format(name))
             if os.path.exists(src):
-                stale = (not os.path.exists(so)
-                         or os.path.getmtime(so) < os.path.getmtime(src))
-                if stale:
-                    # Compile to a private temp file, then atomically rename:
-                    # many executor processes race this build on one host, and
-                    # dlopen of a half-written .so would permanently demote
-                    # that process to the pure-python fallback.
-                    tmp = "{}.tmp.{}".format(so, os.getpid())
-                    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                           "-o", tmp, src]
-                    logger.info("building native lib: %s", " ".join(cmd))
-                    subprocess.run(cmd, check=True, capture_output=True,
-                                   timeout=120)
-                    os.replace(tmp, so)
+                _compile(src, so, ["-shared", "-fPIC"], timeout=120)
                 lib = ctypes.CDLL(so)
         except Exception:
             logger.warning("native %s unavailable; using pure-python fallback",

@@ -90,6 +90,153 @@ def serialize_apply(model, params, input_signature, platforms=("cpu", "tpu")):
     return exported.serialize(), exported.platforms
 
 
+_SHORT_DTYPES = {
+    "float32": "f32", "float64": "f64", "float16": "f16",
+    "bfloat16": "bf16", "int8": "s8", "int16": "s16", "int32": "s32",
+    "int64": "s64", "uint8": "u8", "uint16": "u16", "uint32": "u32",
+    "uint64": "u64", "bool": "pred",
+}
+
+
+def _np_dtype(name):
+    """numpy dtype by name, reaching into ml_dtypes for bf16 etc."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def serialize_embedded(model, params, input_signature, batch_size=128,
+                       platform="tpu"):
+    """Serialize a **params-embedded**, fixed-batch StableHLO module for the
+    native C++ PJRT runner (``native/pjrt_runner.cc``).
+
+    Unlike :func:`serialize_apply` (params as arguments, batch-polymorphic,
+    served by jax), this bakes the trained params into the module as
+    constants and fixes the batch size, so the program's arguments are
+    exactly the input tensors — a C++ host can feed raw buffers with no
+    checkpoint loader.  Returns ``(mlir_bytes, compile_options_bytes,
+    io_meta)`` where io_meta records the flattened input/output order the
+    runner must follow.
+    """
+    import jax
+    from jax import export as jexport
+
+    sig = _normalize_signature(input_signature)
+    apply_fn = build_apply_fn(model, sig)
+
+    def embedded(inputs):
+        return apply_fn(params, inputs)
+
+    names = sorted(sig) if sig else ["_x"]
+    ispec = {}
+    for t in names:
+        spec = sig.get(t, {"shape": None, "dtype": "float32"})
+        shape = [batch_size] + list((spec["shape"] or [None])[1:])
+        ispec[t] = jax.ShapeDtypeStruct(tuple(shape),
+                                        _np_dtype(spec["dtype"]))
+    exported = jexport.export(jax.jit(embedded),
+                              platforms=(platform,))(ispec)
+    mlir = exported.mlir_module_serialized
+
+    # the export already traced the fn: recover the output structure from it
+    out_shapes = jax.tree_util.tree_unflatten(exported.out_tree,
+                                              list(exported.out_avals))
+    outputs = _name_outputs(out_shapes)
+    out_names = (sorted(outputs) if isinstance(out_shapes, dict)
+                 else list(outputs))
+
+    def short(dt):
+        name = _np_dtype(dt).name
+        if name not in _SHORT_DTYPES:
+            raise ValueError("dtype {} unsupported by the native runner"
+                             .format(name))
+        return _SHORT_DTYPES[name]
+
+    meta = {
+        "batch_size": batch_size,
+        "platform": platform,
+        # flattened argument order: sorted tensor names (dict pytree order)
+        "inputs": [{"name": t, "dtype": short(ispec[t].dtype),
+                    "shape": list(ispec[t].shape)} for t in names],
+        "outputs": [{"name": n, "dtype": short(outputs[n].dtype),
+                     "shape": list(outputs[n].shape)} for n in out_names],
+    }
+    from jax._src.lib import xla_client
+
+    options = xla_client.CompileOptions().SerializeAsString()
+    return mlir, options, meta
+
+
+def run_embedded_native(export_dir, feed, plugin_path, runner_path=None,
+                        workdir=None):
+    """Serve one batch through the C++ PJRT runner: write the feed arrays as
+    raw buffers, invoke ``native/pjrt_runner``, read the outputs back.
+
+    ``feed``: dict of input arrays matching the embedded module's signature
+    (padded to its fixed batch size).  Returns ``{output_name: ndarray}``.
+    This is the no-Python-on-the-critical-path serving proof; a production
+    TPU host would run the binary directly against its libtpu.so.
+    """
+    import json
+    import os
+    import subprocess
+    import tempfile
+
+    from tensorflowonspark_tpu import native
+    from tensorflowonspark_tpu.checkpoint import _fs_path
+
+    export_dir = _fs_path(export_dir)
+    with open(os.path.join(export_dir, "export.json")) as f:
+        desc = json.load(f)
+    emb = desc.get("embedded_mlir")
+    if not emb:
+        raise ValueError("export has no embedded_mlir artifact; re-export "
+                         "with embed_batch_size set")
+    runner = runner_path or native.build_executable(
+        "pjrt_runner", include_dirs=native.pjrt_include_dirs())
+    if not runner:
+        raise RuntimeError("pjrt_runner binary unavailable (toolchain or "
+                           "pjrt_c_api.h missing)")
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="pjrt_serve_")
+    cmd = [runner, "--plugin", plugin_path,
+           "--program", os.path.join(export_dir, emb["file"]),
+           "--options", os.path.join(export_dir, emb["options_file"]),
+           "--out", os.path.join(workdir, "out")]
+    for spec in emb["inputs"]:
+        rev = {v: k for k, v in _SHORT_DTYPES.items()}
+        arr = np.ascontiguousarray(np.asarray(feed[spec["name"]]),
+                                   dtype=_np_dtype(rev[spec["dtype"]]))
+        if list(arr.shape) != list(spec["shape"]):
+            raise ValueError("input {} has shape {}, module wants {}".format(
+                spec["name"], arr.shape, spec["shape"]))
+        path = os.path.join(workdir, spec["name"] + ".bin")
+        arr.tofile(path)
+        cmd += ["--input", "{}:{}:{}".format(
+            spec["dtype"], ",".join(str(d) for d in spec["shape"]), path)]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError("pjrt_runner failed (rc={}):\n{}\n{}".format(
+                proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:]))
+        outputs = {}
+        rev = {v: k for k, v in _SHORT_DTYPES.items()}
+        for i, spec in enumerate(emb["outputs"]):
+            raw = np.fromfile(os.path.join(workdir, "out.{}.bin".format(i)),
+                              dtype=_np_dtype(rev[spec["dtype"]]))
+            outputs[spec["name"]] = raw.reshape(spec["shape"])
+        return outputs
+    finally:
+        if own_workdir:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
 class ModelServer(object):
     """Loads an export once and serves batched jit inference.
 

@@ -125,3 +125,76 @@ def test_serving_without_models_package(twotower_export, tmp_path):
                       user=np.asarray([[1.0, 0.0, 0.0]], np.float32),
                       item=np.asarray([[0.0, 1.0, 0.0]], np.float32))
     assert abs(score - float(ref["score"][0])) < 1e-4
+
+
+def test_embedded_mlir_export(tmp_path):
+    """embed_batch_size writes the native-runner artifact: params-embedded
+    fixed-batch StableHLO + compile options + an IO contract in the
+    descriptor, and the C++ runner binary builds against the shipped
+    pjrt_c_api.h."""
+    model = get_model("two_tower", embed_dim=4)
+    params = model.init(jax.random.PRNGKey(0), user=jnp.zeros((1, 3)),
+                        item=jnp.zeros((1, 3)))["params"]
+    params = jax.tree_util.tree_map(np.asarray, params)
+    export_dir = str(tmp_path / "export")
+    checkpoint.export_model(
+        export_dir, params, "two_tower", model_config={"embed_dim": 4},
+        input_signature={"user": {"shape": [None, 3], "dtype": "float32"},
+                         "item": {"shape": [None, 3], "dtype": "float32"}},
+        model=model, embed_batch_size=4, embed_platform="cpu")
+    assert os.path.exists(os.path.join(export_dir, "apply_embedded.mlir"))
+    assert os.path.exists(os.path.join(export_dir, "compile_options.pb"))
+    with open(os.path.join(export_dir, "export.json")) as f:
+        desc = json.load(f)
+    emb = desc["embedded_mlir"]
+    assert emb["batch_size"] == 4
+    # flattened argument order is sorted tensor names
+    assert [i["name"] for i in emb["inputs"]] == ["item", "user"]
+    assert all(i["shape"] == [4, 3] and i["dtype"] == "f32"
+               for i in emb["inputs"])
+    assert [o["name"] for o in emb["outputs"]] == ["score", "user_embedding"]
+    assert emb["outputs"][0]["shape"] == [4]
+    assert emb["outputs"][1]["shape"] == [4, 4]
+
+    # the native runner builds (execution needs a PJRT plugin + device;
+    # see test_embedded_native_serving below).  Building needs g++ and the
+    # pjrt_c_api.h header from an installed accelerator wheel — both
+    # best-effort at runtime, so their absence skips rather than fails.
+    from tensorflowonspark_tpu import native
+
+    dirs = native.pjrt_include_dirs()
+    if not dirs:
+        pytest.skip("no pjrt_c_api.h available (tensorflow wheel absent)")
+    exe = native.build_executable("pjrt_runner", include_dirs=dirs)
+    if exe is None:
+        pytest.skip("C++ toolchain unavailable")
+
+
+def test_embedded_native_serving(tmp_path):
+    """Full no-Python serving through the C++ PJRT runner.  Needs a real
+    PJRT plugin + device: set TFOS_PJRT_PLUGIN (e.g. to libtpu.so on a TPU
+    host); skipped otherwise."""
+    plugin = os.environ.get("TFOS_PJRT_PLUGIN")
+    if not plugin:
+        pytest.skip("TFOS_PJRT_PLUGIN not set (no PJRT plugin/device here)")
+    from tensorflowonspark_tpu import serving as serving_mod
+
+    model = get_model("two_tower", embed_dim=4)
+    params = model.init(jax.random.PRNGKey(0), user=jnp.zeros((1, 3)),
+                        item=jnp.zeros((1, 3)))["params"]
+    params = jax.tree_util.tree_map(np.asarray, params)
+    export_dir = str(tmp_path / "export")
+    platform = os.environ.get("TFOS_PJRT_PLATFORM", "tpu")
+    checkpoint.export_model(
+        export_dir, params, "two_tower", model_config={"embed_dim": 4},
+        input_signature={"user": {"shape": [None, 3], "dtype": "float32"},
+                         "item": {"shape": [None, 3], "dtype": "float32"}},
+        model=model, embed_batch_size=4, embed_platform=platform)
+    rng = np.random.default_rng(5)
+    users = rng.random((4, 3), np.float32)
+    items = rng.random((4, 3), np.float32)
+    out = serving_mod.run_embedded_native(
+        export_dir, {"user": users, "item": items}, plugin)
+    ref = model.apply({"params": params}, user=users, item=items)
+    np.testing.assert_allclose(out["score"], np.asarray(ref["score"]),
+                               rtol=1e-4, atol=1e-4)

@@ -160,3 +160,96 @@ class TestTransformer:
         for _ in range(30):
             last, _ = tr.step(batch)
         assert float(last) < float(first) * 0.5
+
+
+class TestMoE:
+    """Switch-style MoE FFN: dense one-hot dispatch/combine (no gathers),
+    capacity drops ride the residual, load-balance aux folds into the loss,
+    and expert weights shard over the mesh's expert axis."""
+
+    def _model(self, **kw):
+        from tensorflowonspark_tpu.models import transformer
+
+        return transformer.build_transformer(
+            vocab_size=64, num_layers=2, num_heads=2, head_dim=8,
+            max_seq_len=16, mlp="moe", num_experts=4, **kw)
+
+    def test_forward_and_aux_loss(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tensorflowonspark_tpu.models import transformer
+
+        model = self._model()
+        tokens = jnp.asarray(np.arange(4 * 16).reshape(4, 16) % 64, jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        # expert weights exist with the stacked [E, ...] layout
+        w1 = params["block_0"]["moe"]["w1"]
+        assert w1.shape[0] == 4
+        loss = transformer.loss_fn(model)
+        mask = jnp.ones((4,), jnp.float32)
+        l, aux = jax.jit(lambda p: loss(p, {"tokens": tokens}, mask))(params)
+        assert np.isfinite(float(l))
+        # 2 MoE blocks each sow one aux term; folded value is finite
+        assert np.isfinite(float(aux["moe_aux_loss"]))
+
+    def test_training_step_decreases_loss(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from tensorflowonspark_tpu.models import transformer
+
+        model = self._model()
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        loss = transformer.loss_fn(model)
+        opt = optax.adam(1e-2)
+        opt_state = opt.init(params)
+        mask = jnp.ones((8,), jnp.float32)
+
+        @jax.jit
+        def step(params, opt_state):
+            (l, _), g = jax.value_and_grad(loss, has_aux=True)(
+                params, {"tokens": tokens}, mask)
+            updates, opt_state = opt.update(g, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, l
+
+        first = None
+        for _ in range(15):
+            params, opt_state, l = step(params, opt_state)
+            first = first if first is not None else float(l)
+        assert float(l) < first, (float(l), first)
+
+    def test_expert_parallel_sharding_matches_replicated(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tensorflowonspark_tpu.parallel import build_mesh, tp_param_shardings
+
+        mesh = build_mesh({"data": 2, "expert": 4})
+        model = self._model()
+        tokens = jnp.asarray(np.arange(4 * 16).reshape(4, 16) % 64, jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+        def fwd(p, t):
+            return model.apply({"params": p}, t)
+
+        base = jax.jit(fwd)(params, tokens)
+        # shard ONLY the expert-stacked weights over the expert axis; the
+        # axis-generic TP API + rules express expert parallelism directly
+        shardings = tp_param_shardings(
+            params, mesh, axis="expert",
+            rules=[("moe/(w1|w2|b1|b2)", 0), ("", None)])
+        ep_params = jax.device_put(params, shardings)
+        specs = [str(s.spec) for s in jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda x: x.sharding, ep_params))]
+        assert any("expert" in s for s in specs)
+        with mesh:
+            out = jax.jit(fwd)(ep_params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                   rtol=2e-3, atol=2e-3)

@@ -1,0 +1,19 @@
+"""Debug helper: print one MNIST CSV row as a 28x28 glyph (reference
+``examples/utils/mnist_reshape.py`` — stdin row -> printable array).
+
+    head -1 mnist/csv/train/part-00000.csv | python examples/utils/mnist_reshape.py
+"""
+
+import sys
+
+import numpy as np
+
+vec = [float(x) for x in next(sys.stdin).split(",")]
+# data_setup rows are (label, 784 pixels)
+label, pixels = int(vec[0]), np.asarray(vec[1:])
+img = pixels.reshape(28, 28)
+chars = " .:-=+*#%@"
+print("label:", label)
+for row in img:
+    print("".join(chars[min(int(v / 256.0 * len(chars)), len(chars) - 1)]
+                  for v in row))

@@ -1,0 +1,138 @@
+"""Stage-by-stage microbenchmark of the SPARK-mode data plane.
+
+Times each hop a feed row takes (serialization, queue/ring IPC, batch
+assembly, driver pipe ship) in isolation for the MNIST workload shape —
+the numbers behind docs/PERF.md.  Run on any host:
+
+    python scripts/profile_feed.py
+"""
+import os, pickle, sys, time
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ROWS = 60000
+BATCH = 1024
+CHUNK = 256
+rng = np.random.default_rng(0)
+images = (rng.random((ROWS, 784)) * 255).astype(np.float32)
+labels = rng.integers(0, 10, (ROWS,), np.int64)
+data = [(images[i], int(labels[i])) for i in range(ROWS)]
+
+def report(name, secs, n_items):
+    per_batch = secs / n_items * BATCH * 1000
+    print(f"{name:45s} {n_items/secs:>12.0f} items/s  {per_batch:8.2f} ms/1024-batch")
+
+# A. pickle a 256-row block of (ndarray, int) tuples (feeder -> ring)
+blocks = [data[i:i+CHUNK] for i in range(0, 20480, CHUNK)]
+t0 = time.perf_counter()
+bl = [pickle.dumps(b, protocol=pickle.HIGHEST_PROTOCOL) for b in blocks]
+t1 = time.perf_counter()
+report("A pickle row-blocks (256 tuples)", t1-t0, 20480)
+
+# A2. unpickle
+t0 = time.perf_counter()
+ub = [pickle.loads(b) for b in bl]
+t1 = time.perf_counter()
+report("A2 unpickle row-blocks", t1-t0, 20480)
+
+# B. columnar pack: np.stack per block then pickle
+t0 = time.perf_counter()
+cb = []
+for b in blocks:
+    imgs = np.stack([r[0] for r in b])
+    labs = np.asarray([r[1] for r in b], np.int64)
+    cb.append(pickle.dumps((imgs, labs), protocol=pickle.HIGHEST_PROTOCOL))
+t1 = time.perf_counter()
+report("B columnar pack+pickle (stack+dumps)", t1-t0, 20480)
+
+t0 = time.perf_counter()
+ucb = [pickle.loads(b) for b in cb]
+t1 = time.perf_counter()
+report("B2 unpickle columnar blocks", t1-t0, 20480)
+
+# C. consumer assembly: 1024 list-appends + np.stack (current next_batch+preprocess)
+items = data[:BATCH*8]
+t0 = time.perf_counter()
+for s in range(8):
+    out = []
+    for it in items[s*BATCH:(s+1)*BATCH]:
+        out.append(it)
+    imgs = np.stack([r[0] for r in out]).astype(np.float32)
+    labs = np.asarray([r[1] for r in out], np.int32)
+t1 = time.perf_counter()
+report("C per-item assembly + np.stack", t1-t0, BATCH*8)
+
+# C2. columnar assembly: concat 4 blocks of (256,784)
+colblocks = [(np.stack([r[0] for r in b]), np.asarray([r[1] for r in b])) for b in blocks[:32]]
+t0 = time.perf_counter()
+for s in range(8):
+    bs = colblocks[s*4:(s+1)*4]
+    imgs = np.concatenate([b[0] for b in bs])
+    labs = np.concatenate([b[1] for b in bs])
+t1 = time.perf_counter()
+report("C2 columnar concat assembly", t1-t0, BATCH*8)
+
+# D. manager-queue chunk round trip (proxy IPC per chunk token)
+from tensorflowonspark_tpu import manager as manager_mod
+from tensorflowonspark_tpu import marker
+mgr = manager_mod.start(b"prof", ["input"])
+q = mgr.get_queue("input")
+t0 = time.perf_counter()
+N = 40
+for i in range(N):
+    q.put(marker.Chunk(blocks[i % len(blocks)]), block=True)
+for i in range(N):
+    c = q.get(block=True)
+    q.task_done()
+t1 = time.perf_counter()
+report("D manager-queue Chunk round trip", t1-t0, N*CHUNK)
+
+# D2. queue with just a small token (ShmChunk path token cost)
+t0 = time.perf_counter()
+for i in range(200):
+    q.put(marker.ShmChunk("x", CHUNK), block=True)
+for i in range(200):
+    q.get(block=True); q.task_done()
+t1 = time.perf_counter()
+report("D2 manager-queue token round trip", t1-t0, 200*CHUNK)
+mgr.shutdown()
+
+# E. shm ring put/get of pickled row-block vs columnar
+from tensorflowonspark_tpu import shmring
+if shmring.available():
+    ring = shmring.get_ring("profring", create=True)
+    t0 = time.perf_counter()
+    for i in range(64):
+        ring.put_bytes(bl[i % len(bl)], timeout_secs=10)
+        ring.get_bytes(10)
+    t1 = time.perf_counter()
+    report("E shm ring rt (row-block bytes)", t1-t0, 64*CHUNK)
+    t0 = time.perf_counter()
+    for i in range(64):
+        ring.put_bytes(cb[i % len(cb)], timeout_secs=10)
+        ring.get_bytes(10)
+    t1 = time.perf_counter()
+    report("E2 shm ring rt (columnar bytes)", t1-t0, 64*CHUNK)
+    shmring.unlink("profring")
+else:
+    print("shmring unavailable")
+
+# F. driver pipe ship of a 7500-row partition (multiprocessing Pipe)
+import multiprocessing as mp
+ctx = mp.get_context("spawn")
+a, b = ctx.Pipe()
+part = data[:7500]
+import threading
+def rx():
+    for _ in range(4):
+        b.recv()
+t = threading.Thread(target=rx); t.start()
+t0 = time.perf_counter()
+for _ in range(4):
+    a.send((0, b"fn", part))
+t.join()
+t1 = time.perf_counter()
+report("F driver pipe ship (7500-row part)", t1-t0, 7500*4)
+
+print("\nper-1024-batch budget at 310 ms/step: where does it go?")

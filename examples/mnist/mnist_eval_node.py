@@ -80,7 +80,13 @@ def evaluator_fun(args, ctx):
             l, aux = loss(state.params, eval_batch, mask)
             metrics = {"step": int(step), "loss": float(l),
                        "accuracy": float(aux["accuracy"])}
-            with open("eval_metrics.jsonl", "a") as f:
+            # metrics land next to the checkpoints (shared storage), not in
+            # whatever cwd the evaluator process happens to run from
+            from tensorflowonspark_tpu.datafeed import strip_scheme
+
+            metrics_path = os.path.join(strip_scheme(model_dir),
+                                        "eval_metrics.jsonl")
+            with open(metrics_path, "a") as f:
                 f.write(json.dumps(metrics) + "\n")
             print("evaluator: step {} loss {:.4f} acc {:.3f}".format(
                 step, metrics["loss"], metrics["accuracy"]))

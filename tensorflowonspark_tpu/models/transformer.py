@@ -4,6 +4,9 @@ The reference framework predates attention entirely (SURVEY §5.7); this model
 is the long-context showcase of the TPU-native design: the same module runs
 
 - ``attention="full"``     — plain causal attention (single device / small S),
+- ``attention="flash"``    — the pallas FlashAttention-2 kernels
+  (:mod:`tensorflowonspark_tpu.ops.flash_attention`): memory-linear in S,
+  hand-scheduled VMEM traffic on TPU, interpret mode elsewhere,
 - ``attention="ring"``     — ring attention over the mesh's ``"seq"`` axis
   (sequence parallelism; see :mod:`tensorflowonspark_tpu.parallel.ring`),
 - ``attention="ulysses"``  — all-to-all head-parallel attention.
@@ -25,7 +28,7 @@ from tensorflowonspark_tpu.parallel import ring
 class Attention(nn.Module):
     num_heads: int
     head_dim: int
-    attention: str = "full"   # full | ring | ulysses
+    attention: str = "full"   # full | flash | ring | ulysses
     mesh: Optional[object] = None
     dtype: jnp.dtype = jnp.float32
 
@@ -35,7 +38,11 @@ class Attention(nn.Module):
         qkv = nn.DenseGeneral((3, self.num_heads, self.head_dim),
                               dtype=self.dtype, name="qkv")(x)
         q, k, v = (qkv[:, :, i] for i in range(3))
-        if self.attention == "ring":
+        if self.attention == "flash":
+            from tensorflowonspark_tpu.ops import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        elif self.attention == "ring":
             assert self.mesh is not None, "ring attention needs a mesh"
             out = ring.ring_attention(q, k, v, self.mesh, causal=True)
         elif self.attention == "ulysses":

@@ -1,0 +1,9 @@
+"""First-party pallas TPU kernels for the hot ops.
+
+The compute path is jax/XLA; these kernels cover the few ops where
+hand-scheduling VMEM traffic beats XLA's fusion — attention first
+(:mod:`~tensorflowonspark_tpu.ops.flash_attention`).  Every kernel runs in
+pallas interpret mode off-TPU, so the suite validates them on the CPU mesh.
+"""
+
+from tensorflowonspark_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,301 @@
+"""FlashAttention-2 as pallas TPU kernels (forward + backward).
+
+The attention contraction is the transformer's hot op; materializing the
+[S, S] score matrix in HBM caps sequence length and burns bandwidth.  These
+kernels stream K/V blocks through VMEM with an online softmax, so HBM
+traffic is O(S·D) and the MXU sees back-to-back [block_q, D]x[D, block_k]
+matmuls:
+
+- forward: one kernel over grid (batch*heads, q_blocks, k_blocks) with
+  running (max, sum, acc) scratch carried across the k dimension; also
+  emits the logsumexp rows the backward needs.
+- backward: the FlashAttention-2 split — one kernel accumulating dQ over k
+  blocks, one accumulating dK/dV over q blocks — recomputing p = exp(qk -
+  L) from the saved logsumexp instead of storing probabilities.
+
+Off-TPU the same kernels run in pallas interpret mode (tests compare
+against the reference attention, values and grads), so
+``attention="flash"`` is portable; on TPU they compile to Mosaic.
+
+Layout contract: ``[batch, seq, heads, dim]`` like
+:mod:`~tensorflowonspark_tpu.parallel.ring`; blocks default to 128 (MXU
+tile) and the sequence length must divide by the block size (pad upstream
+— model code here keeps S a power of two).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _default_interpret():
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, n_k):
+    from jax.experimental import pallas as pl
+
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
+    k = k_ref[0].astype(jnp.float32)                  # [BK, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # [BQ, BK]
+    if causal:
+        i = pl.program_id(1)
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + kk * block_k
+        s = jnp.where(rows >= cols, s, NEG_INF)
+
+    m_prev = m_scr[:]                                  # [BQ, 1]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                             # [BQ, BK]
+    alpha = jnp.exp(m_prev - m_new)                    # [BQ, 1]
+    l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1, keepdims=True)
+    v = v_ref[0].astype(jnp.float32)                   # [BK, D]
+    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    m_scr[:] = m_new
+
+    @pl.when(kk == n_k - 1)
+    def _emit():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, s_len, d = q.shape
+    n_q = s_len // block_q
+    n_k = s_len // block_k
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_k=n_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, kk: (b, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, kk: (b, kk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, kk: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_len), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _recompute_p(q_ref, k_ref, lse_ref, scale, causal, q_block_id, k_block_id,
+                 block_q, block_k):
+    """exp(q k^T * scale - L) for one (q block, k block) tile."""
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        rows = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                + q_block_id * block_q)
+        cols = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                + k_block_id * block_k)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return jnp.exp(s - lse_ref[0][:, None])            # [BQ, BK]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, causal, block_q, block_k, n_k):
+    from jax.experimental import pallas as pl
+
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    p = _recompute_p(q_ref, k_ref, lse_ref, scale, causal,
+                     pl.program_id(1), kk, block_q, block_k)
+    do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
+    v = v_ref[0].astype(jnp.float32)                   # [BK, D]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0][:, None])              # [BQ, BK]
+    k = k_ref[0].astype(jnp.float32)
+    dq_scr[:] += scale * jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, block_q, block_k, n_q):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    p = _recompute_p(q_ref, k_ref, lse_ref, scale, causal,
+                     qi, pl.program_id(1), block_q, block_k)
+    do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
+    dv_scr[:] += jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    v = v_ref[0].astype(jnp.float32)                   # [BK, D]
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0][:, None])              # [BQ, BK]
+    q = q_ref[0].astype(jnp.float32)
+    dk_scr[:] += scale * jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == n_q - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    q, k, v, out, lse = res
+    bh, s_len, d = q.shape
+    n_q = s_len // block_q
+    n_k = s_len // block_k
+    # D_i = rowsum(dO * O) — tiny elementwise pass, left to XLA
+    delta = (g.astype(jnp.float32) * out.astype(jnp.float32)).sum(-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_k=n_k),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, kk: (b, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, kk: (b, kk, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, kk: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, kk: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, kk: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, kk: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, kk, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kk, i: (b, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kk, i: (b, kk, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, kk, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, kk, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, kk, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, kk, i: (b, kk, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, kk, i: (b, kk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
+    scale = 1.0 / (res[0].shape[-1] ** 0.5)
+    return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                    interpret=None):
+    """Memory-linear attention over ``[batch, seq, heads, dim]`` inputs.
+
+    Differentiable (custom FlashAttention-2 backward kernels); softmax
+    statistics live in fp32 regardless of input dtype.  ``block_q/k``
+    default to the 128 MXU tile and are clamped to the sequence length;
+    ``seq`` must divide by the clamped blocks.  ``interpret`` defaults to
+    True off-TPU so the same kernel runs (slowly) everywhere.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    batch, s_len, heads, dim = q.shape
+    block_q = min(block_q, s_len)
+    block_k = min(block_k, s_len)
+    assert s_len % block_q == 0 and s_len % block_k == 0, (
+        "seq len {} must divide by blocks ({}, {})".format(
+            s_len, block_q, block_k))
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(batch * heads, s_len, dim)
+
+    out = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k,
+                 interpret)
+    return out.reshape(batch, heads, s_len, dim).transpose(0, 2, 1, 3)

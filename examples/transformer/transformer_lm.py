@@ -42,7 +42,7 @@ def main_fun(args, ctx):
         vocab_size=args.vocab_size, num_layers=args.num_layers,
         num_heads=args.num_heads, head_dim=args.head_dim,
         max_seq_len=args.seq_len,
-        attention="ring" if args.seq > 1 else "full",
+        attention=args.attention or ("ring" if args.seq > 1 else "full"),
         mesh=mesh, dtype=args.dtype)
     # Init through a full-attention twin: same params, no divisibility
     # constraint on the init batch (see __graft_entry__.dryrun_multichip).
@@ -128,6 +128,11 @@ def main(argv=None):
                         help="data-parallel mesh degree")
     parser.add_argument("--seq", type=int, default=2,
                         help="sequence-parallel (ring attention) degree")
+    parser.add_argument("--attention", default=None,
+                        choices=[None, "full", "flash", "ring", "ulysses"],
+                        help="override the attention kernel (default: ring "
+                             "when --seq > 1, else full; 'flash' uses the "
+                             "pallas FlashAttention-2 kernels)")
     parser.add_argument("--tensor", type=int, default=2,
                         help="tensor-parallel degree")
     parser.add_argument("--dtype", default="float32",

@@ -43,10 +43,12 @@ def main_fun(args, ctx):
         num_heads=args.num_heads, head_dim=args.head_dim,
         max_seq_len=args.seq_len,
         attention=args.attention or ("ring" if args.seq > 1 else "full"),
+        mlp=args.mlp, num_experts=args.num_experts,
         mesh=mesh, dtype=args.dtype)
     # Init through a full-attention twin: same params, no divisibility
     # constraint on the init batch (see __graft_entry__.dryrun_multichip).
     init_model = tfm.build_transformer(
+        mlp=args.mlp, num_experts=args.num_experts,
         vocab_size=args.vocab_size, num_layers=args.num_layers,
         num_heads=args.num_heads, head_dim=args.head_dim,
         max_seq_len=args.seq_len, dtype=args.dtype)
@@ -128,6 +130,12 @@ def main(argv=None):
                         help="data-parallel mesh degree")
     parser.add_argument("--seq", type=int, default=2,
                         help="sequence-parallel (ring attention) degree")
+    parser.add_argument("--mlp", default="dense",
+                        choices=["dense", "moe"],
+                        help="FFN flavor; 'moe' = Switch-style mixture of "
+                             "experts (shard experts over the mesh's "
+                             "expert axis)")
+    parser.add_argument("--num_experts", type=int, default=8)
     parser.add_argument("--attention", default=None,
                         choices=[None, "full", "flash", "ring", "ulysses"],
                         help="override the attention kernel (default: ring "

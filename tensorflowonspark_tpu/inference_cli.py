@@ -52,6 +52,53 @@ def run_inference(export_dir, rows, input_mapping=None, output_name=None,
         yield row
 
 
+def run_inference_native(export_dir, rows, plugin_path, input_mapping=None,
+                         output_mapping=None):
+    """Serve through the C++ PJRT runner (``native/pjrt_runner``): batches
+    are padded to the embedded module's fixed batch size, fed as raw
+    buffers, and the runner's outputs zip back into one dict per input row.
+    Requires the export to carry the ``embedded_mlir`` artifact
+    (``export_model(..., embed_batch_size=...)``).
+    """
+    import os
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.checkpoint import _fs_path
+
+    with open(os.path.join(_fs_path(export_dir), "export.json")) as f:
+        desc = json.load(f)
+    emb = desc.get("embedded_mlir")
+    if not emb:
+        raise ValueError(
+            "export has no embedded_mlir artifact; re-export with "
+            "embed_batch_size set to use --pjrt_plugin serving")
+    bsz = emb["batch_size"]
+    col_for = {t: c for c, t in (input_mapping or {}).items()}
+    out_col = dict(output_mapping or {})
+    rows = list(rows)
+    for lo in range(0, len(rows), bsz):
+        chunk = rows[lo:lo + bsz]
+        count = len(chunk)
+        feed = {}
+        for spec in emb["inputs"]:
+            tensor = spec["name"]
+            col = col_for.get(tensor, tensor)
+            vals = np.asarray([r[col] for r in chunk])
+            vals = vals.reshape([-1] + list(spec["shape"][1:]))
+            if count < bsz:
+                pad = [(0, bsz - count)] + [(0, 0)] * (vals.ndim - 1)
+                vals = np.pad(vals, pad)
+            feed[tensor] = vals
+        outs = serving.run_embedded_native(export_dir, feed, plugin_path)
+        for i in range(count):
+            row = dict(chunk[i])
+            for tensor, arr in outs.items():
+                cell = arr[i]
+                row[out_col.get(tensor, tensor)] = (
+                    cell.tolist() if cell.ndim else cell.item())
+            yield row
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Batch inference over TFRecords with a framework export "
@@ -67,6 +114,10 @@ def main(argv=None):
                         help='JSON {"tensor": "column"}, one entry per '
                              "output tensor (reference -o)")
     parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--pjrt_plugin", default=None,
+                        help="serve through the native C++ PJRT runner with "
+                             "this plugin .so (e.g. libtpu.so); needs an "
+                             "export with the embedded_mlir artifact")
     parser.add_argument("--output", default=None,
                         help="output JSON-lines path (stdout when omitted)")
     args = parser.parse_args(argv)
@@ -80,13 +131,19 @@ def main(argv=None):
     logger.info("loaded %d rows from %s (schema %s)",
                 len(rows), args.input, rows.schema)
 
+    if args.pjrt_plugin:
+        results = run_inference_native(
+            args.export_dir, rows, args.pjrt_plugin,
+            input_mapping=input_mapping, output_mapping=output_mapping)
+    else:
+        results = run_inference(args.export_dir, rows,
+                                input_mapping=input_mapping,
+                                output_mapping=output_mapping,
+                                batch_size=args.batch_size)
     out_f = open(args.output, "w") if args.output else sys.stdout
     try:
         n = 0
-        for out in run_inference(args.export_dir, rows,
-                                 input_mapping=input_mapping,
-                                 output_mapping=output_mapping,
-                                 batch_size=args.batch_size):
+        for out in results:
             out_f.write(json.dumps(out) + "\n")
             n += 1
         logger.info("wrote %d predictions", n)

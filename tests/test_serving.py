@@ -198,3 +198,52 @@ def test_embedded_native_serving(tmp_path):
     ref = model.apply({"params": params}, user=users, item=items)
     np.testing.assert_allclose(out["score"], np.asarray(ref["score"]),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_cli_native_path_batches_and_zips(tmp_path, monkeypatch):
+    """run_inference_native pads each batch to the embedded module's fixed
+    size, feeds by input_mapping, and zips runner outputs 1:1 onto rows —
+    validated against a stubbed runner (real execution needs a plugin)."""
+    from tensorflowonspark_tpu import inference_cli, serving as serving_mod
+
+    model = get_model("two_tower", embed_dim=4)
+    params = model.init(jax.random.PRNGKey(0), user=jnp.zeros((1, 3)),
+                        item=jnp.zeros((1, 3)))["params"]
+    params = jax.tree_util.tree_map(np.asarray, params)
+    export_dir = str(tmp_path / "export")
+    checkpoint.export_model(
+        export_dir, params, "two_tower", model_config={"embed_dim": 4},
+        input_signature={"user": {"shape": [None, 3], "dtype": "float32"},
+                         "item": {"shape": [None, 3], "dtype": "float32"}},
+        model=model, embed_batch_size=4, embed_platform="cpu")
+
+    calls = []
+
+    def fake_runner(export_dir_, feed, plugin_path, **kw):
+        calls.append({k: v.shape for k, v in feed.items()})
+        # emulate the real module on the padded batch
+        out = model.apply({"params": params},
+                          user=feed["user"], item=feed["item"])
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    monkeypatch.setattr(serving_mod, "run_embedded_native", fake_runner)
+
+    rng = np.random.default_rng(9)
+    rows = [{"u": rng.random(3).astype(np.float32).tolist(),
+             "i": rng.random(3).astype(np.float32).tolist()}
+            for _ in range(6)]  # 4 + 2: second batch padded
+    outs = list(inference_cli.run_inference_native(
+        export_dir, rows, "/fake/plugin.so",
+        input_mapping={"u": "user", "i": "item"},
+        output_mapping={"score": "score", "user_embedding": "emb"}))
+    assert len(outs) == 6
+    assert len(calls) == 2 and all(s == (4, 3) for c in calls
+                                   for s in c.values())
+    users = np.asarray([r["u"] for r in rows], np.float32)
+    items = np.asarray([r["i"] for r in rows], np.float32)
+    ref = model.apply({"params": params}, user=users, item=items)
+    for k, out in enumerate(outs):
+        assert abs(out["score"] - float(ref["score"][k])) < 1e-5
+        np.testing.assert_allclose(out["emb"],
+                                   np.asarray(ref["user_embedding"][k]),
+                                   rtol=1e-5)

@@ -253,21 +253,18 @@ def _flash_bwd(res, g, scale, causal, block_q, block_k, interpret):
 # public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    scale = 1.0 / (q.shape[-1] ** 0.5)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, block_q, block_k, interpret, scale):
     out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
-    scale = 1.0 / (q.shape[-1] ** 0.5)
+def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret, scale):
     out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, block_q, block_k, interpret, res, g):
-    scale = 1.0 / (res[0].shape[-1] ** 0.5)
+def _flash_vjp_bwd(causal, block_q, block_k, interpret, scale, res, g):
     return _flash_bwd(res, g, scale, causal, block_q, block_k, interpret)
 
 
@@ -275,7 +272,7 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
-                    interpret=None):
+                    interpret=None, scale=None):
     """Memory-linear attention over ``[batch, seq, heads, dim]`` inputs.
 
     Differentiable (custom FlashAttention-2 backward kernels); softmax
@@ -287,6 +284,8 @@ def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
     if interpret is None:
         interpret = _default_interpret()
     batch, s_len, heads, dim = q.shape
+    if scale is None:
+        scale = 1.0 / (dim ** 0.5)
     block_q = min(block_q, s_len)
     block_k = min(block_k, s_len)
     assert s_len % block_q == 0 and s_len % block_k == 0, (
@@ -297,5 +296,5 @@ def flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
         return x.transpose(0, 2, 1, 3).reshape(batch * heads, s_len, dim)
 
     out = _flash(fold(q), fold(k), fold(v), causal, block_q, block_k,
-                 interpret)
+                 interpret, scale)
     return out.reshape(batch, heads, s_len, dim).transpose(0, 2, 1, 3)

@@ -117,8 +117,15 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", batch_axis="data",
     return fn(q, k, v)
 
 
-def _ulysses_shard_fn(q, k, v, axis_name, causal, scale):
-    """Per-device body: all_to_all seq->heads, local full attention, back."""
+def _ulysses_shard_fn(q, k, v, axis_name, causal, scale, impl="einsum"):
+    """Per-device body: all_to_all seq->heads, local full attention, back.
+
+    ``impl="flash"`` runs the local attention through the pallas
+    FlashAttention kernels (memory-linear in S — the einsum path
+    materializes a per-device [B, H/P, S, S] score tensor); unlike ring
+    attention the local softmax is complete, so no cross-device statistics
+    are needed and the kernel composes directly.
+    """
 
     def seq_to_heads(x):  # [B, S/P, H, D] -> [B, S, H/P, D]
         x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
@@ -130,6 +137,11 @@ def _ulysses_shard_fn(q, k, v, axis_name, causal, scale):
                                   tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if impl == "flash":
+        from tensorflowonspark_tpu.ops import flash_attention
+
+        og = flash_attention(qg, kg, vg, causal=causal, scale=scale)
+        return heads_to_seq(og)
     s = jnp.einsum("bqhd,bkhd->bhqk", qg.astype(jnp.float32),
                    kg.astype(jnp.float32)) * scale
     if causal:
@@ -142,7 +154,7 @@ def _ulysses_shard_fn(q, k, v, axis_name, causal, scale):
 
 
 def ulysses_attention(q, k, v, mesh, seq_axis="seq", batch_axis="data",
-                      causal=False, scale=None):
+                      causal=False, scale=None, impl="einsum"):
     """All-to-all ("Ulysses"-style) sequence-parallel attention.
 
     Requires ``heads % mesh.shape[seq_axis] == 0``; each device attends over
@@ -160,8 +172,10 @@ def ulysses_attention(q, k, v, mesh, seq_axis="seq", batch_axis="data",
     spec = P(batch, seq_axis, None, None)
     fn = shard_map(
         functools.partial(_ulysses_shard_fn, axis_name=seq_axis,
-                          causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                          causal=causal, scale=scale, impl=impl),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        # flash: pallas_call emits ShapeDtypeStructs without vma annotations
+        check_vma=(impl != "flash"))
     return fn(q, k, v)
 
 

@@ -120,3 +120,12 @@ def test_resnet_imagenet_synthetic():
                        "--blocks_per_stage", "1",     # 14-layer: compile fast
                        "--image_size", "64", "--synthetic_examples", "64"])
     assert "train stats" in out
+
+
+@pytest.mark.slow
+def test_mnist_eval_node(tmp_path):
+    out = run_example("mnist/mnist_eval_node.py",
+                      ["--cluster_size", "3", "--max_steps", "20",
+                       "--save_interval", "10",
+                       "--model_dir", str(tmp_path / "ckpt")])
+    assert "evaluator: step 20" in out

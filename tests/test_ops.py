@@ -96,3 +96,17 @@ def test_transformer_flash_mode_matches_full():
     got = flash.apply({"params": params}, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(base),
                                atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_with_flash_inner(causal):
+    """Sequence parallelism (Ulysses a2a) composed with the pallas kernel:
+    per-device local attention runs flash, output matches the reference."""
+    from tensorflowonspark_tpu.parallel import build_mesh
+
+    q, k, v = _qkv(batch=2, seq=128, heads=4, dim=16, seed=2)
+    mesh = build_mesh({"data": 2, "seq": 4})
+    want = ring.reference_attention(q, k, v, causal=causal)
+    got = ring.ulysses_attention(q, k, v, mesh, causal=causal, impl="flash")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)

@@ -268,6 +268,27 @@ def _leg_subprocess(leg, out_path):
         timeout=LEG_TIMEOUT_SECS[leg])
 
 
+def probe_device(timeout=150):
+    """Fast pre-flight: can a fresh process see the accelerator at all?
+
+    When the TPU tunnel is unreachable, jax initialization BLOCKS (observed:
+    minutes); without this check each device leg would burn its full
+    subprocess timeout x retries before failing.  Returns
+    ``(device_kind, None)`` or ``(None, error_string)``.
+    """
+    code = "import jax; print(jax.devices()[0].device_kind)"
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                              capture_output=True, text=True)
+        if proc.returncode == 0 and proc.stdout.strip():
+            return proc.stdout.strip().splitlines()[-1], None
+        return None, "device probe rc={}: {}".format(
+            proc.returncode, proc.stderr[-300:])
+    except subprocess.TimeoutExpired:
+        return None, ("device probe timed out after {}s (accelerator/tunnel "
+                      "unreachable)".format(timeout))
+
+
 def run_leg_isolated(leg, retries=1):
     """Execute a leg with subprocess isolation + retry; returns
     ``(stats_or_None, error_or_None)``."""
@@ -292,8 +313,15 @@ def run_leg_isolated(leg, retries=1):
 
 
 def main():
-    resnet, resnet_err = run_leg_isolated("resnet")
-    mnist, mnist_err = run_leg_isolated("mnist")
+    kind, probe_err = probe_device()
+    if probe_err:
+        print("bench: {} -- skipping device legs".format(probe_err),
+              file=sys.stderr)
+        resnet = mnist = None
+        resnet_err = mnist_err = probe_err
+    else:
+        resnet, resnet_err = run_leg_isolated("resnet")
+        mnist, mnist_err = run_leg_isolated("mnist")
     ceiling, ceiling_err = run_leg_isolated("ceiling")
 
     out = {
@@ -311,7 +339,7 @@ def main():
         "mnist_e2e_images_per_sec_per_chip": None,
         "vs_baseline": None,
         "mnist_ms_per_step": None,
-        "device_kind": (resnet or mnist or {}).get("device_kind"),
+        "device_kind": (resnet or mnist or {}).get("device_kind") or kind,
     }
     if mnist:
         n_dev = max(int(mnist.get("n_devices", 1)), 1)

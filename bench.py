@@ -50,7 +50,8 @@ MNIST_EPOCHS = int(os.environ.get("TFOS_BENCH_MNIST_EPOCHS", 4))
 RESNET_BATCH = int(os.environ.get("TFOS_BENCH_RESNET_BATCH", 256))
 RESNET_STEPS = int(os.environ.get("TFOS_BENCH_RESNET_STEPS", 60))
 
-LEG_TIMEOUT_SECS = {"mnist": 1200, "resnet": 1200, "ceiling": 120}
+LEG_TIMEOUT_SECS = {"mnist": 1200, "resnet": 1200, "feedplane": 600,
+                    "ceiling": 120}
 
 
 # ---------------------------------------------------------------------------
@@ -225,6 +226,51 @@ def measure_resnet50(batch_size=RESNET_BATCH, steps=RESNET_STEPS):
     return _run_cluster(resnet_main, args, cluster.InputMode.FILES)
 
 
+def feedplane_main(args, ctx):
+    """Runs on the executor: drain the columnar feed as fast as the plane
+    delivers — no jax anywhere, so the measured rate is the data plane
+    itself (chunk pack + ring IPC + columnar assembly).  Stops at the
+    expected row budget (the end-of-feed sentinel only arrives with the
+    shutdown job, which the driver sends after reading our stats)."""
+    feed = ctx.get_data_feed(train_mode=True)
+    # whole batches only: a final partial request would block on a queue
+    # whose end sentinel arrives only with the shutdown job
+    target = (args.expected_rows // args.batch_size) * args.batch_size
+    t0 = time.time()
+    rows = 0
+    while rows < target and not feed.should_stop():
+        arrays, count = feed.next_batch_arrays(args.batch_size)
+        if count == 0:
+            break
+        rows += count
+    elapsed = time.time() - t0
+    feed.terminate()
+    stats = {"rows": rows, "elapsed": elapsed,
+             "items_per_sec": rows / max(elapsed, 1e-9)}
+    with open(args.stats_path, "w") as f:
+        json.dump(stats, f)
+    return stats
+
+
+def measure_feedplane(rows=MNIST_ROWS, epochs=2):
+    """End-to-end SPARK feed throughput with a no-op consumer: the
+    data-plane counterpart of the reference's per-element ceiling (same
+    row shape, whole cluster lifecycle, zero device time)."""
+    from tensorflowonspark_tpu import backend, cluster
+
+    rng = np.random.default_rng(0)
+    images = (rng.random((rows, 784)) * 255).astype(np.uint8)
+    labels = rng.integers(0, 10, (rows,), np.int64)
+    data = [(images[i], int(labels[i])) for i in range(rows)]
+    args = argparse.Namespace(
+        batch_size=1024, chunk_size=2048,
+        expected_rows=rows * epochs,
+        stats_path=os.path.join(tempfile.mkdtemp(), "feed_stats.json"))
+    return _run_cluster(
+        feedplane_main, args, cluster.InputMode.SPARK,
+        feed_partitions=backend.partition(data, 8), num_epochs=epochs)
+
+
 def measure_reference_feed_ceiling(n_items=60000):
     """Throughput ceiling of the reference's per-element manager-proxy feed
     (one IPC round trip per example, reference ``TFNode.py:124-149``):
@@ -255,6 +301,7 @@ def measure_reference_feed_ceiling(n_items=60000):
 _LEGS = {
     "mnist": measure_mnist_e2e,
     "resnet": measure_resnet50,
+    "feedplane": measure_feedplane,
     "ceiling": measure_reference_feed_ceiling,
 }
 
@@ -322,6 +369,8 @@ def main():
     else:
         resnet, resnet_err = run_leg_isolated("resnet")
         mnist, mnist_err = run_leg_isolated("mnist")
+    # device-free legs: run regardless of accelerator health
+    feedplane, feedplane_err = run_leg_isolated("feedplane")
     ceiling, ceiling_err = run_leg_isolated("ceiling")
 
     out = {
@@ -339,8 +388,21 @@ def main():
         "mnist_e2e_images_per_sec_per_chip": None,
         "vs_baseline": None,
         "mnist_ms_per_step": None,
+        # data plane alone (no device in the loop): SPARK feed -> columnar
+        # assembly drained by a no-op consumer, vs the reference's
+        # per-element manager-hop ceiling
+        "feed_plane_images_per_sec": None,
+        "feed_plane_vs_baseline": None,
         "device_kind": (resnet or mnist or {}).get("device_kind") or kind,
     }
+    if feedplane:
+        out["feed_plane_images_per_sec"] = round(
+            feedplane["items_per_sec"], 1)
+        if ceiling:
+            out["feed_plane_vs_baseline"] = round(
+                feedplane["items_per_sec"] / ceiling["items_per_sec"], 2)
+    elif feedplane_err:
+        out["feedplane_error"] = feedplane_err
     if mnist:
         n_dev = max(int(mnist.get("n_devices", 1)), 1)
         ips = mnist["avg_exp_per_second"] / n_dev

@@ -322,3 +322,35 @@ def test_driver_ps_nodes(local_backend):
     results = c.inference(backend.partition(range(12), 4))
     assert sorted(results) == [x + 100 for x in range(12)]
     c.shutdown(grace_secs=1)
+
+
+def test_columnar_feed_without_shm_ring():
+    """TFOS_DISABLE_SHM: columnar chunks travel in-queue (no ring), same
+    semantics — the fallback path for hosts without the native transport."""
+    import numpy as np
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed()
+        total = 0
+        while not feed.should_stop():
+            arrays, count = feed.next_batch_arrays(8)
+            if count:
+                total += int(arrays[1].sum())
+        with open("sum.txt", "w") as f:
+            f.write(str(total))
+
+    b = backend.LocalBackend(2, env={"TFOS_DISABLE_SHM": "1"})
+    try:
+        rows = [(np.full(3, i, np.float32), i) for i in range(16)]
+        c = cluster.run(b, map_fun, tf_args=[], num_executors=2,
+                        input_mode=InputMode.SPARK)
+        c.train(backend.partition(rows, 4), num_epochs=2, chunk_size=4)
+        c.shutdown()
+        total = 0
+        for i in range(2):
+            with open(os.path.join(b.workdir_root,
+                                   "executor-{}".format(i), "sum.txt")) as f:
+                total += int(f.read())
+        assert total == sum(range(16)) * 2
+    finally:
+        b.stop()

@@ -107,19 +107,29 @@ class TimeHistory(object):
             jax.device_get(jax.block_until_ready(value))
 
     def on_step_end(self, value=None):
+        self.on_steps_end(1, value)
+
+    def on_steps_end(self, n, value=None):
+        """Record ``n`` global steps completed by one dispatch (n > 1 when a
+        ``lax.scan`` group ran K steps on device, see ``Trainer.multi_step``).
+        A window closes whenever the step counter crosses a ``log_steps``
+        boundary; window length in steps is tracked exactly, so throughput
+        stays honest even when boundaries land mid-group."""
         if self.train_start_time is None:
             self.on_train_begin()
-        self.global_steps += 1
-        if self.global_steps % self.log_steps == 0:
+        before = self.global_steps
+        self.global_steps += n
+        if self.global_steps // self.log_steps > before // self.log_steps:
             self._sync(value)
             now = time.time()
+            window_steps = self.global_steps - self.timestamp_log[-1][0]
             elapsed = now - self.start_time
-            eps = self.batch_size * self.log_steps / elapsed
+            eps = self.batch_size * window_steps / elapsed
             msg = ("step %d: %.1f examples/sec (%.1f/sec/chip), "
                    "%.1f ms/step" % (
                        self.global_steps, eps, eps / self.num_devices,
-                       1000 * elapsed / self.log_steps))
-            mfu = self.mfu(elapsed / self.log_steps)
+                       1000 * elapsed / window_steps))
+            mfu = self.mfu(elapsed / window_steps)
             if mfu is not None:
                 msg += ", %.1f%% MFU" % (100 * mfu)
             logger.info(msg)

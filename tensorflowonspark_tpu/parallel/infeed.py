@@ -129,8 +129,8 @@ class ShardedFeed(object):
         stop, even when Spark partitions are uneven across hosts.
         """
         stop = self._stop = threading.Event()
-        source = (self._prefetched_locals(stop) if self._prefetch_depth
-                  else self._sharded_iter())
+        source = (self._prefetched(stop, self._sharded_iter())
+                  if self._prefetch_depth else self._sharded_iter())
         try:
             for item in source:
                 has_data = item is not None
@@ -144,6 +144,59 @@ class ShardedFeed(object):
                 yield batch, mask
         finally:
             stop.set()  # wind the prefetch thread down on any exit path
+
+    def grouped_batches(self, k):
+        """Generator of ``("multi", batch_stack, mask_stack)`` groups of K
+        device-resident full batches (leaves shaped ``(k, local_batch, ...)``,
+        sharded per :func:`~...mesh.scan_batch_sharding`) and
+        ``("single", batch, mask)`` items for tails that can't fill a group.
+
+        SPMD lock-step across hosts: before each group all hosts agree they
+        ALL hold a full group; the first disagreement permanently degrades
+        everyone to single-step mode (groups already assembled are split back
+        into singles on device), where the per-step end-of-data consensus of
+        :meth:`batches` takes over.  This keeps the sequence of jitted
+        programs (K-step scan vs single step) identical on every host even
+        when Spark partitions are uneven.
+        """
+        stop = self._stop = threading.Event()
+        source = (self._prefetched(stop, self._grouped_sharded_iter(k))
+                  if self._prefetch_depth else self._grouped_sharded_iter(k))
+        grouped_ok = True
+        try:
+            for item in source:
+                if grouped_ok:
+                    is_group = item is not None and item[0] == "multi"
+                    if collectives.all_hosts_agree(is_group):
+                        yield item
+                        continue
+                    grouped_ok = False
+                    logger.info("degrading to single-step mode (a host "
+                                "cannot fill a %d-step group)", k)
+                for single in self._degrade(item, k):
+                    has_data = single is not None
+                    if not collectives.end_of_data_consensus(
+                            self.mesh, has_data):
+                        return
+                    yield single
+        finally:
+            stop.set()
+
+    @staticmethod
+    def _degrade(item, k):
+        """Split one grouped-iterator item into single-step items (device
+        slicing for an assembled group); a trailing ``None`` stays ``None``
+        so the caller's consensus sees end-of-feed."""
+        import jax
+
+        if item is None:
+            return [None]
+        if item[0] == "single":
+            return [item]
+        _, stack, masks = item
+        return [("single",
+                 jax.tree_util.tree_map(lambda x: x[i], stack),
+                 masks[i]) for i in range(k)]
 
     def terminate(self):
         """Terminate feeding early (training hit max steps with data left):
@@ -189,7 +242,57 @@ class ShardedFeed(object):
             batch, mask = self._shard(arrays, count)
             yield batch, mask, count
 
-    def _prefetched_locals(self, stop):
+    def _grouped_sharded_iter(self, k):
+        """Yields ``("multi", stack, masks)`` for runs of K full local
+        batches (stacked columnar on host, ONE transfer per group) and
+        ``("single", batch, mask)`` for tails, then a single ``None``.
+
+        Once any batch arrives short (end of feed / epoch tail) the iterator
+        stays in single mode — partial batches only occur at the end of the
+        feed, and a deterministic mode switch keeps hosts alignable."""
+        import jax
+
+        scan_sharding = mesh_mod.scan_batch_sharding(self.mesh)
+
+        def put_stack(cols):
+            stacked = np.stack([np.asarray(c) for c in cols])
+            return jax.make_array_from_process_local_data(
+                scan_sharding, stacked)
+
+        # Loop invariant: every group's rows are all real, so the (k, B) mask
+        # stack is built and transferred once and reused for every group
+        # (multi_step does not donate it).
+        masks = None
+        pending = []  # full (arrays, count) locals awaiting a k-group
+        singles_mode = False
+        for local in self._local_iter():
+            if local is None:
+                break
+            arrays, count = local
+            if not singles_mode and count == self.local_batch_size:
+                pending.append(arrays)
+                if len(pending) == k:
+                    stack = jax.tree_util.tree_map(
+                        lambda *cols: put_stack(cols), *pending)
+                    if masks is None:
+                        masks = put_stack(
+                            [np.ones((self.local_batch_size,), np.float32)] * k)
+                    pending = []
+                    yield ("multi", stack, masks)
+                continue
+            singles_mode = True
+            for p in pending:
+                b, m = self._shard(p, self.local_batch_size)
+                yield ("single", b, m)
+            pending = []
+            b, m = self._shard(arrays, count)
+            yield ("single", b, m)
+        for p in pending:
+            b, m = self._shard(p, self.local_batch_size)
+            yield ("single", b, m)
+        yield None
+
+    def _prefetched(self, stop, source_iter):
         """Host-thread prefetch: overlap queue drain, numpy assembly AND the
         host->device transfer with the device step (double buffering by
         default — each prefetched batch is already device-resident, so the
@@ -212,7 +315,7 @@ class ShardedFeed(object):
             # the buffer so the consumer re-raises instead of blocking forever
             # on a producer that died without its None sentinel.
             try:
-                for item in self._sharded_iter():
+                for item in source_iter:
                     if not _put(item):
                         return
             except BaseException as exc:  # noqa: B036 — relayed, not handled

@@ -103,6 +103,23 @@ def batch_sharding(mesh, extra_dims=0):
     return NamedSharding(mesh, spec)
 
 
+def scan_batch_sharding(mesh):
+    """NamedSharding for a **stacked group** of batches with shape
+    ``(k, batch, ...)``: the leading scan dim is unsharded (every device
+    steps through all k microbatches in lock-step via ``lax.scan``), the
+    second dim is batch-sharded like :func:`batch_sharding`.
+
+    Used by the K-steps-per-dispatch path
+    (:meth:`~tensorflowonspark_tpu.train.Trainer.multi_step`), which
+    amortizes per-step host dispatch and transfer overhead — the dominant
+    cost on remotely-attached TPU backends."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    batch_axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+    return NamedSharding(
+        mesh, PartitionSpec(None, batch_axes if batch_axes else None))
+
+
 def replicated(mesh):
     """Fully-replicated NamedSharding on this mesh."""
     from jax.sharding import NamedSharding, PartitionSpec

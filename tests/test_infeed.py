@@ -150,3 +150,70 @@ def test_trainer_fit_feed_end_to_end(mgr):
     stats = tr.fit_feed(sf)
     assert stats["global_steps"] == 3  # 8 + 8 + 4(padded)
     assert "loss" in stats
+
+
+def test_grouped_batches_full_groups(mgr):
+    """32 rows, batch 8, k=2 -> two ('multi', stack, masks) groups with
+    leaves shaped (2, 8, ...)."""
+    _fill(mgr, [[float(i)] for i in range(32)])
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=0)
+    out = list(sf.grouped_batches(2))
+    assert [kind for kind, _, _ in out] == ["multi", "multi"]
+    kind, stack, masks = out[0]
+    assert np.asarray(stack).shape == (2, 8, 1)
+    assert np.asarray(masks).shape == (2, 8)
+    assert np.asarray(masks).sum() == 16
+
+
+def test_grouped_batches_tail_degrades_to_singles(mgr):
+    """20 rows, batch 8, k=2 -> one full group (16 rows) then a padded
+    4-row single; the mode switch is permanent."""
+    _fill(mgr, [[float(i)] for i in range(20)])
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=2)
+    out = list(sf.grouped_batches(2))
+    assert [kind for kind, _, _ in out] == ["multi", "single"]
+    _, batch, mask = out[1]
+    assert np.asarray(batch).shape == (8, 1)
+    assert np.asarray(mask).sum() == 4
+
+
+def test_grouped_batches_pending_flush(mgr):
+    """k=4 with only 2 full batches available: the pending group can't fill,
+    so both batches arrive as singles (exact same rows, no loss)."""
+    _fill(mgr, [[float(i)] for i in range(16)])
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=0)
+    out = list(sf.grouped_batches(4))
+    assert [kind for kind, _, _ in out] == ["single", "single"]
+    got = np.concatenate([np.asarray(b).ravel() for _, b, _ in out])
+    np.testing.assert_array_equal(np.sort(got), np.arange(16, dtype=np.float32))
+
+
+def test_fit_feed_steps_per_call_trains_all_steps(mgr):
+    """fit_feed(steps_per_call=2) consumes the same data as single-step mode
+    and reports the same step count."""
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(40):
+        x = [float(v) for v in rng.rand(2)]
+        rows.append((x, float(np.dot(x, [3.14, 1.618]))))
+    _fill(mgr, rows)
+    feed = DataFeed(mgr, input_mapping={"a_x": "x", "b_y": "y"})
+    mesh = build_mesh()
+    sf = ShardedFeed(feed, mesh, global_batch_size=8, prefetch=2)
+
+    from tensorflowonspark_tpu.train import Trainer
+    import jax.numpy as jnp
+
+    def loss(params, batch, mask):
+        pred = jnp.asarray(batch["x"]) @ params["w"]
+        err = (pred - jnp.asarray(batch["y"])) ** 2 * mask
+        return err.sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+    tr = Trainer(loss, {"w": jnp.zeros((2,))}, optax.adam(0.1), mesh=mesh,
+                 batch_size=8, log_steps=2)
+    stats = tr.fit_feed(sf, steps_per_call=2)
+    assert stats["global_steps"] == 5  # 40 rows / batch 8: 2 groups + 1 single
+    assert "loss" in stats

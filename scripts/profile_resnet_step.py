@@ -33,6 +33,7 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--batch_size", type=int, default=256)
     p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--repeat_k", type=int, default=10)
     args = p.parse_args()
 
     dev = jax.devices()[0]
@@ -77,6 +78,15 @@ def main():
           % (1000 * t_pipe, mfu(flops, t_pipe)), flush=True)
     print("train step, per-step sync: %.1f ms  (%.1f%% MFU)"
           % (1000 * t_sync, mfu(flops, t_sync)), flush=True)
+
+    # K steps per dispatch: isolates pure device compute from dispatch
+    # latency (one host round trip per K steps).
+    k = args.repeat_k
+    trainer.repeat_step(batch, mask, k)  # compile
+    t_rep = timed(lambda: trainer.repeat_step(batch, mask, k), lambda x: x,
+                  max(args.steps // k, 2), per_step_sync=True) / k
+    print("train step, scan k=%d: %.1f ms/step  (%.1f%% MFU)"
+          % (k, 1000 * t_rep, mfu(flops, t_rep)), flush=True)
 
     # forward only
     @jax.jit

@@ -27,6 +27,20 @@ from tensorflowonspark_tpu.parallel import collectives, mesh as mesh_mod
 
 logger = logging.getLogger(__name__)
 
+_GROUP_SLICER = None
+
+
+def _group_slicer():
+    """Jitted ``(tree, i) -> tree[i]`` along the leading (scan) dim.  The
+    index is a traced scalar, so all k slices share one compilation."""
+    global _GROUP_SLICER
+    if _GROUP_SLICER is None:
+        import jax
+
+        _GROUP_SLICER = jax.jit(
+            lambda tree, i: jax.tree_util.tree_map(lambda x: x[i], tree))
+    return _GROUP_SLICER
+
 
 class ShardedFeed(object):
     """Iterator of device-resident, mesh-sharded global batches from a DataFeed.
@@ -186,17 +200,20 @@ class ShardedFeed(object):
     def _degrade(item, k):
         """Split one grouped-iterator item into single-step items (device
         slicing for an assembled group); a trailing ``None`` stays ``None``
-        so the caller's consensus sees end-of-feed."""
-        import jax
+        so the caller's consensus sees end-of-feed.
 
+        The slice runs under jit: on a multi-host mesh the stacked arrays
+        are global (not fully addressable), so eager indexing would be
+        rejected — and multi-host uneven partitions are exactly when this
+        path runs.  The index is a traced argument (one compile for all k).
+        """
         if item is None:
             return [None]
         if item[0] == "single":
             return [item]
         _, stack, masks = item
-        return [("single",
-                 jax.tree_util.tree_map(lambda x: x[i], stack),
-                 masks[i]) for i in range(k)]
+        slice_fn = _group_slicer()
+        return [("single",) + slice_fn((stack, masks), i) for i in range(k)]
 
     def terminate(self):
         """Terminate feeding early (training hit max steps with data left):

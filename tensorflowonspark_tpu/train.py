@@ -88,9 +88,12 @@ class Trainer(object):
         # Own our buffers: device_put is a no-op for already-resident arrays,
         # and the donated step would then delete buffers the caller (or a
         # sibling Trainer built from the same init_params) still holds.
+        # Jitted copy (not eager .copy()): global arrays on a multi-host mesh
+        # are not fully addressable, so eager ops on them are rejected; a jit
+        # identity runs SPMD and always materializes fresh output buffers.
         if donate:
-            self.state = jax.tree_util.tree_map(
-                lambda x: x.copy() if hasattr(x, "copy") else x, self.state)
+            self.state = jax.jit(
+                lambda t: jax.tree_util.tree_map(jnp.copy, t))(self.state)
 
         def train_step(state, batch, mask):
             if self.compute_dtype is not None:
@@ -140,6 +143,42 @@ class Trainer(object):
                 multi, donate_argnums=self._donate)
         return self._multi_cache[k]
 
+    def _get_repeat_step(self, k):
+        """Jitted program running ``k`` train steps over the SAME batch in
+        one dispatch (``lax.scan`` with no scanned inputs).  The synthetic-
+        benchmark counterpart of :meth:`multi_step` (reference benchmark
+        mode reuses one device-resident batch, ``common.py:315-363``)."""
+        key = ("repeat", k)
+        if key not in self._multi_cache:
+            def repeat(state, batch, mask):
+                def body(st, _):
+                    new_st, loss, _ = self._step_core(st, batch, mask)
+                    return new_st, loss
+                state, losses = jax.lax.scan(body, state, None, length=k)
+                return state, losses[-1]
+            self._multi_cache[key] = jax.jit(
+                repeat, donate_argnums=self._donate)
+        return self._multi_cache[key]
+
+    def _ensure_history(self, fn, args, steps_per_dispatch=1):
+        """Lazily build the metrics recorder from ``fn``'s XLA cost analysis
+        (per-dispatch FLOPs / ``steps_per_dispatch`` = per-step FLOPs)."""
+        if self.history is None:
+            flops = metrics_mod.estimate_step_flops(fn, self.state, *args)
+            self.history = metrics_mod.TimeHistory(
+                batch_size=self.batch_size or 0, log_steps=self.log_steps,
+                step_flops=(flops / steps_per_dispatch) if flops else None)
+            self.history.on_train_begin()
+
+    def repeat_step(self, batch, mask, k):
+        """Run ``k`` steps on one batch in a single dispatch; returns the
+        final step's loss."""
+        fn = self._get_repeat_step(k)
+        self._ensure_history(fn, (batch, mask), steps_per_dispatch=k)
+        self.state, loss = fn(self.state, batch, mask)
+        self.history.on_steps_end(k, loss)
+        return loss
+
     def multi_step(self, batches, masks):
         """Run K steps in one dispatch; ``batches``/``masks`` leaves carry a
         leading scan dim K (see :func:`~...parallel.mesh.scan_batch_sharding`
@@ -147,13 +186,7 @@ class Trainer(object):
         Returns the final step's loss."""
         k = int(jax.tree_util.tree_leaves(masks)[0].shape[0])
         fn = self._get_multi_step(k)
-        if self.history is None:
-            flops = metrics_mod.estimate_step_flops(
-                fn, self.state, batches, masks)
-            self.history = metrics_mod.TimeHistory(
-                batch_size=self.batch_size or 0, log_steps=self.log_steps,
-                step_flops=flops / k if flops else None)
-            self.history.on_train_begin()
+        self._ensure_history(fn, (batches, masks), steps_per_dispatch=k)
         self.state, loss = fn(self.state, batches, masks)
         self.history.on_steps_end(k, loss)
         return loss
@@ -182,9 +215,7 @@ class Trainer(object):
         if mask is None:
             first = jax.tree_util.tree_leaves(batch)[0]
             mask = jnp.ones((first.shape[0],), jnp.float32)
-        if self.history is None:
-            self.compile_and_measure(batch, mask)
-            self.history.on_train_begin()
+        self._ensure_history(self._train_step, (batch, mask))
         self.state, loss, aux = self._train_step(self.state, batch, mask)
         # Passing the loss lets TimeHistory sync on device completion at
         # window boundaries (honest ms/step + MFU under async dispatch);

@@ -161,13 +161,18 @@ class Trainer(object):
         return self._multi_cache[key]
 
     def _ensure_history(self, fn, args, steps_per_dispatch=1):
-        """Lazily build the metrics recorder from ``fn``'s XLA cost analysis
-        (per-dispatch FLOPs / ``steps_per_dispatch`` = per-step FLOPs)."""
+        """Lazily build the metrics recorder from ``fn``'s XLA cost analysis.
+
+        XLA's HloCostAnalysis counts a while/scan body ONCE (trip count is
+        not multiplied — verified empirically: a scan-of-4 program reports
+        1.0x the single-step flops), so the cost of a K-step scan program
+        IS the per-step cost; dividing by K would under-state MFU by ~K."""
+        del steps_per_dispatch  # per-dispatch cost == per-step cost, above
         if self.history is None:
             flops = metrics_mod.estimate_step_flops(fn, self.state, *args)
             self.history = metrics_mod.TimeHistory(
                 batch_size=self.batch_size or 0, log_steps=self.log_steps,
-                step_flops=(flops / steps_per_dispatch) if flops else None)
+                step_flops=flops)
             self.history.on_train_begin()
 
     def repeat_step(self, batch, mask, k):

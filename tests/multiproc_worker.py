@@ -91,6 +91,47 @@ def scenario_infeed(rank, world, tmpdir):
     print("infeed ok", rank, mask_sums)
 
 
+def scenario_grouped(rank, world, tmpdir):
+    """grouped_batches across hosts with UNEVEN feeds: rank 0 runs out of
+    full K-groups first, so the group consensus degrades every host to
+    single-step mode in lock-step — rank 1 must split its already-assembled
+    group back into singles via the jitted multi-host-safe slice."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import manager
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel.infeed import ShardedFeed
+
+    mesh = mesh_mod.build_mesh()
+    global_batch = 8 * world
+    # rank 0: 3 full local batches (1 group of 2 + 1 flushed single);
+    # others: 5 full batches (2 groups + 1 pending flushed single).
+    n_rows = 24 if rank == 0 else 40
+    rows = [[float(rank * 1000 + i)] for i in range(n_rows)]
+    mgr = manager.start(b"mp-grouped-%d" % rank, ["input"])
+    q = mgr.get_queue("input")
+    for r in rows:
+        q.put(r)
+    q.put(None)
+
+    sf = ShardedFeed(DataFeed(mgr), mesh, global_batch, prefetch=2)
+    kinds = []
+    mask_sums = []
+    for kind, batch, mask in sf.grouped_batches(2):
+        kinds.append(kind)
+        mask_sums.append(float(jax.jit(jnp.sum)(mask)))
+    mgr.shutdown()
+
+    # group 1 agreed everywhere; the second group attempt disagrees (rank 0
+    # holds a flushed single) -> everyone degrades; one aligned single step
+    # runs; then rank 0 hits end-of-feed and all stop together.
+    assert kinds == ["multi", "single"], (rank, kinds)
+    assert mask_sums == [16.0 * world, 8.0 * world], (rank, mask_sums)
+    print("grouped ok", rank, kinds, mask_sums)
+
+
 def scenario_checkpoint(rank, world, tmpdir):
     import jax
     import jax.numpy as jnp
@@ -120,6 +161,7 @@ def scenario_checkpoint(rank, world, tmpdir):
 SCENARIOS = {
     "consensus": scenario_consensus,
     "infeed": scenario_infeed,
+    "grouped": scenario_grouped,
     "checkpoint": scenario_checkpoint,
 }
 

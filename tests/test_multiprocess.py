@@ -67,6 +67,10 @@ class TestMultiProcess:
         outs = _run_world("infeed", tmp_path)
         assert all("infeed ok" in o for o in outs)
 
+    def test_grouped_feed_degrades_in_lockstep(self, tmp_path):
+        outs = _run_world("grouped", tmp_path)
+        assert all("grouped ok" in o for o in outs)
+
     def test_orbax_collective_save_restore(self, tmp_path):
         outs = _run_world("checkpoint", tmp_path)
         assert all("checkpoint ok" in o for o in outs)

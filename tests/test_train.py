@@ -209,5 +209,8 @@ class TestMultiStep:
             single = Trainer(_linear_loss, params, optax.sgd(0.1), mesh=mesh,
                              batch_size=16, log_steps=8)
             single.step(b)
-            # per-step flops from the scan program ~= the single-step cost
-            assert tr.history.step_flops < 2 * single.history.step_flops
+            # XLA counts the scan body once, so the K-step program's cost IS
+            # the per-step cost: two-sided bound vs the single-step program
+            # (a /k under-count OR a *k over-count must fail this).
+            ratio = tr.history.step_flops / single.history.step_flops
+            assert 0.7 < ratio < 1.5, ratio

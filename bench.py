@@ -51,6 +51,9 @@ MNIST_STEPS_PER_CALL = int(os.environ.get("TFOS_BENCH_MNIST_SPC", 8))
 RESNET_BATCH = int(os.environ.get("TFOS_BENCH_RESNET_BATCH", 256))
 RESNET_STEPS = int(os.environ.get("TFOS_BENCH_RESNET_STEPS", 60))
 RESNET_STEPS_PER_CALL = int(os.environ.get("TFOS_BENCH_RESNET_SPC", 10))
+# "s2d" = space-to-depth stem: exactly-equivalent math (models/resnet.py
+# s2d_stem_kernel + equivalence tests), MXU-friendly layout.
+RESNET_STEM = os.environ.get("TFOS_BENCH_RESNET_STEM", "s2d")
 
 LEG_TIMEOUT_SECS = {"mnist": 1200, "resnet": 1200, "feedplane": 600,
                     "ceiling": 120}
@@ -160,7 +163,7 @@ def resnet_main(args, ctx):
     mesh = mesh_mod.build_mesh()
     sharding = mesh_mod.batch_sharding(mesh)
 
-    model = resnet_mod.build_resnet50(dtype="bfloat16")
+    model = resnet_mod.build_resnet50(dtype="bfloat16", stem=args.stem)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, 224, 224, 3)))
     trainer = train_mod.Trainer(
@@ -264,7 +267,7 @@ def measure_resnet50(batch_size=RESNET_BATCH, steps=RESNET_STEPS):
 
     args = argparse.Namespace(
         batch_size=batch_size, steps=steps, chunk_size=1024,
-        steps_per_call=RESNET_STEPS_PER_CALL,
+        steps_per_call=RESNET_STEPS_PER_CALL, stem=RESNET_STEM,
         stats_path=os.path.join(tempfile.mkdtemp(), "resnet_stats.json"))
     return _run_cluster(resnet_main, args, cluster.InputMode.FILES)
 
@@ -366,25 +369,36 @@ def _leg_subprocess(leg, out_path):
         timeout=LEG_TIMEOUT_SECS[leg])
 
 
-def probe_device(timeout=150):
-    """Fast pre-flight: can a fresh process see the accelerator at all?
+def probe_device(timeout=150, attempts=3, retry_sleep=120):
+    """Pre-flight: can a fresh process see the accelerator at all?
 
     When the TPU tunnel is unreachable, jax initialization BLOCKS (observed:
     minutes); without this check each device leg would burn its full
-    subprocess timeout x retries before failing.  Returns
-    ``(device_kind, None)`` or ``(None, error_string)``.
+    subprocess timeout x retries before failing.  The tunnel also FLAPS
+    (observed: reachable at 04:57, gone by 05:24, same day), so a single
+    failed probe must not zero the round's device numbers: retry a few
+    times with a pause before giving up.  Returns ``(device_kind, None)``
+    or ``(None, error_string)``.
     """
     code = "import jax; print(jax.devices()[0].device_kind)"
-    try:
-        proc = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                              capture_output=True, text=True)
-        if proc.returncode == 0 and proc.stdout.strip():
-            return proc.stdout.strip().splitlines()[-1], None
-        return None, "device probe rc={}: {}".format(
-            proc.returncode, proc.stderr[-300:])
-    except subprocess.TimeoutExpired:
-        return None, ("device probe timed out after {}s (accelerator/tunnel "
-                      "unreachable)".format(timeout))
+    err = None
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(retry_sleep)
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  timeout=timeout, capture_output=True,
+                                  text=True)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return proc.stdout.strip().splitlines()[-1], None
+            err = "device probe rc={}: {}".format(
+                proc.returncode, proc.stderr[-300:])
+        except subprocess.TimeoutExpired:
+            err = ("device probe timed out after {}s (accelerator/tunnel "
+                   "unreachable)".format(timeout))
+        print("bench: {} (attempt {}/{})".format(err, attempt + 1, attempts),
+              file=sys.stderr)
+    return None, err
 
 
 def run_leg_isolated(leg, retries=1):
@@ -407,6 +421,8 @@ def run_leg_isolated(leg, retries=1):
             err = "leg {} failed: {} (attempt {})".format(leg, e, attempt + 1)
         print("bench: {} -- {}".format(err, "retrying" if attempt < retries
                                        else "giving up"), file=sys.stderr)
+        if attempt < retries:
+            time.sleep(60)  # a tunnel flap needs a pause, not an instant retry
     return None, err
 
 

@@ -57,7 +57,10 @@ def main_fun(args, ctx):
     sharded = infeed.ShardedFeed(
         feed, mesh, args.batch_size,
         preprocess=lambda items: preprocess(items))
-    stats = trainer.fit_feed(sharded, max_steps=args.max_steps)
+    # steps_per_call > 1: K steps per lax.scan dispatch (amortizes host
+    # dispatch; tail batches fall back to single steps automatically).
+    stats = trainer.fit_feed(sharded, max_steps=args.max_steps,
+                             steps_per_call=args.steps_per_call)
 
     if args.export_dir and checkpoint.should_export(ctx):
         checkpoint.export_model(
@@ -90,6 +93,9 @@ def main(argv=None):
     parser.add_argument("--epochs", type=int, default=3)
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--max_steps", type=int, default=None)
+    parser.add_argument("--steps_per_call", type=int, default=1,
+                        help="train steps per device dispatch (lax.scan "
+                             "groups; amortizes dispatch latency)")
     parser.add_argument("--data_dir", default=None,
                         help="CSV dir from mnist_data_setup.py; synthetic "
                              "in-memory data when omitted")

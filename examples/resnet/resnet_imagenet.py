@@ -74,7 +74,8 @@ def main_fun(args, ctx):
     # None -> ResNet-50's [3,4,6,3]; 1 -> a 14-layer smoke model.
     model = resnet_mod.build_resnet50(num_classes=NUM_CLASSES,
                                       dtype=args.dtype,
-                                      blocks_per_stage=args.blocks_per_stage)
+                                      blocks_per_stage=args.blocks_per_stage,
+                                      stem=args.stem)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, size, size, 3)), train=False)
     params, batch_stats = variables["params"], variables["batch_stats"]
@@ -157,7 +158,8 @@ def main_fun(args, ctx):
             ctx.absolute_path(args.export_dir),
             jax.device_get(trainer.state.params), "resnet50",
             model_config={"num_classes": NUM_CLASSES, "dtype": args.dtype,
-                          "blocks_per_stage": args.blocks_per_stage},
+                          "blocks_per_stage": args.blocks_per_stage,
+                          "stem": args.stem},
             input_signature={"image": [None, size, size, 3]})
     return stats
 
@@ -181,6 +183,9 @@ def main(argv=None):
     parser.add_argument("--weight_decay", type=float, default=1e-4)
     parser.add_argument("--label_smoothing", type=float, default=0.1,
                         help="reference resnet_imagenet_main.py:98-100")
+    parser.add_argument("--stem", default="conv7", choices=["conv7", "s2d"],
+                        help="s2d = space-to-depth stem (same math, "
+                             "MXU-friendly; models/resnet.py)")
     parser.add_argument("--dtype", default="bfloat16",
                         choices=["float32", "bfloat16"])
     parser.add_argument("--use_synthetic_data", action="store_true")

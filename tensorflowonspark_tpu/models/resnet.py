@@ -21,6 +21,44 @@ from tensorflowonspark_tpu.models import register_model
 ModuleDef = Any
 
 
+def space_to_depth(x, block=2):
+    """NHWC space-to-depth: ``(B, H, W, C) -> (B, H/b, W/b, C*b*b)``.
+
+    Pixel ``(bh*b+i, bw*b+j, c)`` lands in channel ``(i*b + j)*C + c`` of
+    block ``(bh, bw)`` — the layout :func:`s2d_stem_kernel` assumes."""
+    b_, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(
+            "stem='s2d' requires H and W divisible by {} (got {}x{}); use "
+            "stem='conv7' or pad/resize the input".format(block, h, w))
+    x = x.reshape(b_, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b_, h // block, w // block, c * block * block)
+
+
+def s2d_stem_kernel(kernel7):
+    """Transform a ``(7, 7, C, F)`` stride-2 SAME stem kernel into the
+    exactly-equivalent ``(4, 4, C*4, F)`` kernel for a stride-1 conv over
+    :func:`space_to_depth` (block 2) input with padding ``((1, 2), (1, 2))``.
+
+    SAME/stride-2/k=7 taps input ``[2i-2, 2i+4]`` — an even start — so
+    zero-padding the kernel to 8x8 at the bottom/right keeps every tap's
+    block alignment and each 2x2 pixel block folds into the s2d channel
+    dim.  Used by tests to prove equivalence and by converters migrating
+    conv7 checkpoints to s2d models."""
+    import numpy as np
+
+    k = np.asarray(kernel7)
+    kh, kw, c, f = k.shape
+    assert (kh, kw) == (7, 7), (kh, kw)
+    k = np.pad(k, ((0, 1), (0, 1), (0, 0), (0, 0)))  # 8x8, zeros bottom/right
+    # (4, 2, 4, 2, C, F): split each spatial dim into (block_index, offset)
+    k = k.reshape(4, 2, 4, 2, c, f)
+    # s2d channel order is (off_h, off_w, c) -> fold offsets over channels
+    k = k.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 2 * 2 * c, f)
+    return k
+
+
 class BottleneckBlock(nn.Module):
     """ResNet-v1.5 bottleneck: 1x1 -> 3x3(stride) -> 1x1 with projection
     shortcut (stride placement per reference ``resnet_model.py`` v1.5)."""
@@ -87,6 +125,7 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     cifar_stem: bool = False   # 3x3 stem, no max-pool (CIFAR variant)
+    stem: str = "conv7"        # "conv7" | "s2d" (space-to-depth, TPU-fast)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -100,8 +139,17 @@ class ResNet(nn.Module):
             x = norm()(x)
             x = nn.relu(x)
         else:
-            x = conv(self.num_filters, (7, 7), strides=(2, 2),
-                     use_bias=False)(x)
+            if self.stem == "s2d":
+                # Space-to-depth stem: a 7x7/s2 conv on 3 channels starves
+                # the MXU (channels pad 3->8); the exactly-equivalent 4x4/s1
+                # conv on the (H/2, W/2, 4C) space-to-depth input keeps it
+                # fed (kernel mapping: s2d_stem_kernel).
+                x = space_to_depth(x, 2)
+                x = conv(self.num_filters, (4, 4),
+                         padding=((1, 2), (1, 2)), use_bias=False)(x)
+            else:
+                x = conv(self.num_filters, (7, 7), strides=(2, 2),
+                         use_bias=False)(x)
             x = norm()(x)
             x = nn.relu(x)
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
@@ -116,17 +164,19 @@ class ResNet(nn.Module):
 
 
 @register_model("resnet50")
-def build_resnet50(num_classes=1000, dtype="bfloat16", blocks_per_stage=None):
+def build_resnet50(num_classes=1000, dtype="bfloat16", blocks_per_stage=None,
+                  stem="conv7"):
     """ResNet50 v1.5 for ImageNet (reference ``resnet_imagenet_main.py``).
 
     ``blocks_per_stage`` is the size knob (the reference's ``resnet_size``):
     None = the [3,4,6,3] ResNet-50; N = [N,N,N,N] bottleneck stages.  Part
     of the registry signature so exports of custom-depth models rebuild
-    correctly from their descriptor."""
+    correctly from their descriptor.  ``stem="s2d"`` selects the
+    space-to-depth stem (exactly equivalent math, MXU-friendly)."""
     stage_sizes = ([blocks_per_stage] * 4 if blocks_per_stage
                    else [3, 4, 6, 3])
     return ResNet(stage_sizes=stage_sizes, block_cls=BottleneckBlock,
-                  num_classes=num_classes, dtype=jnp.dtype(dtype))
+                  num_classes=num_classes, stem=stem, dtype=jnp.dtype(dtype))
 
 
 @register_model("resnet56_cifar")

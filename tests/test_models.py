@@ -253,3 +253,49 @@ class TestMoE:
             out = jax.jit(fwd)(ep_params, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(base),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestS2dStem:
+    def test_stem_kernel_transform_exact(self):
+        """The (4,4,12,F) s2d kernel must reproduce the 7x7/s2 SAME conv
+        exactly (fp32, random input) — lone stem conv, no BN/pool."""
+        import jax
+        from jax import lax
+
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 32, 32, 3).astype(np.float32)
+        k7 = rng.rand(7, 7, 3, 8).astype(np.float32) - 0.5
+
+        ref = lax.conv_general_dilated(
+            x, k7, window_strides=(2, 2), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        k4 = resnet.s2d_stem_kernel(k7)
+        y = resnet.space_to_depth(jnp.asarray(x), 2)
+        got = lax.conv_general_dilated(
+            np.asarray(y), k4, window_strides=(1, 1),
+            padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_s2d_model_matches_conv7_model(self):
+        """Full ResNet forward: transplanting the transformed stem kernel
+        into the s2d model reproduces the conv7 model's logits."""
+        import jax
+
+        m7 = models.get_model("resnet50", num_classes=5, dtype="float32",
+                              blocks_per_stage=1)
+        ms = models.get_model("resnet50", num_classes=5, dtype="float32",
+                              blocks_per_stage=1, stem="s2d")
+        x = np.random.RandomState(1).rand(2, 64, 64, 3).astype(np.float32)
+        v7 = m7.init(jax.random.PRNGKey(0), x)
+        vs_params = dict(v7["params"])
+        stem7 = v7["params"]["Conv_0"]["kernel"]
+        vs_params["Conv_0"] = {"kernel": jnp.asarray(
+            resnet.s2d_stem_kernel(stem7))}
+        out7 = m7.apply({"params": v7["params"],
+                         "batch_stats": v7["batch_stats"]}, x)
+        outs = ms.apply({"params": vs_params,
+                         "batch_stats": v7["batch_stats"]}, x)
+        np.testing.assert_allclose(np.asarray(outs), np.asarray(out7),
+                                   rtol=1e-4, atol=1e-4)

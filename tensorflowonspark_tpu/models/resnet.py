@@ -139,6 +139,10 @@ class ResNet(nn.Module):
             x = norm()(x)
             x = nn.relu(x)
         else:
+            if self.stem not in ("conv7", "s2d"):
+                raise ValueError(
+                    "unknown stem {!r}; expected 'conv7' or 's2d'".format(
+                        self.stem))
             if self.stem == "s2d":
                 # Space-to-depth stem: a 7x7/s2 conv on 3 channels starves
                 # the MXU (channels pad 3->8); the exactly-equivalent 4x4/s1

@@ -64,17 +64,27 @@ class Trainer(object):
         params/optimizer state stay fp32).
       batch_size: global batch size (for throughput metrics).
       log_steps: TimeHistory window.
+      accum_steps: gradient accumulation — split each batch into this many
+        sequential microbatch grad passes (lax.scan) with one optimizer
+        update; peak activation memory drops by ~accum_steps and the batch
+        dim must be divisible by it.  Microbatch grads/losses are averaged
+        weighted by each microbatch's mask count, which reproduces the
+        full-batch update EXACTLY for masked-MEAN losses
+        (``masked_sum / mask.sum()`` plus mask-independent terms like
+        weight decay — the form every framework loss uses); a masked-SUM
+        loss would instead see its microbatch grads reweighted.
     """
 
     def __init__(self, loss_fn, init_params, optimizer, mesh=None,
                  extra_state=None, compute_dtype=None, batch_size=None,
-                 log_steps=20, donate=True):
+                 log_steps=20, donate=True, accum_steps=1):
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh()
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.compute_dtype = compute_dtype
         self.batch_size = batch_size
         self.log_steps = log_steps
+        self.accum_steps = accum_steps
         self._has_extra = extra_state is not None
 
         replicated = mesh_mod.replicated(self.mesh)
@@ -95,33 +105,93 @@ class Trainer(object):
             self.state = jax.jit(
                 lambda t: jax.tree_util.tree_map(jnp.copy, t))(self.state)
 
-        def train_step(state, batch, mask):
-            if self.compute_dtype is not None:
-                batch = jax.tree_util.tree_map(
-                    lambda x: x.astype(self.compute_dtype)
-                    if jnp.issubdtype(x.dtype, jnp.floating) else x, batch)
+        def grad_micro(params, extra, batch, mask):
+            """Loss + grads on one (micro)batch against fixed params;
+            returns the updated non-trainable state and the aux dict with
+            ``extra_state`` split out (so scan doesn't stack A copies)."""
             if self._has_extra:
-                def wrapped(params):
-                    return self.loss_fn(params, state.extra, batch, mask)
+                def wrapped(p):
+                    return self.loss_fn(p, extra, batch, mask)
             else:
-                def wrapped(params):
-                    return self.loss_fn(params, batch, mask)
+                def wrapped(p):
+                    return self.loss_fn(p, batch, mask)
             (loss, aux), grads = jax.value_and_grad(
-                wrapped, has_aux=True)(state.params)
-            updates, new_opt = self.optimizer.update(
-                grads, state.opt_state, state.params)
-            import optax
-
-            new_params = optax.apply_updates(state.params, updates)
-            new_extra = state.extra
+                wrapped, has_aux=True)(params)
+            new_extra = extra
             if self._has_extra and isinstance(aux, dict) and "extra_state" in aux:
                 new_extra = aux["extra_state"]
+                aux = {k: v for k, v in aux.items() if k != "extra_state"}
+            return loss, aux, grads, new_extra
+
+        def cast_batch(batch):
+            if self.compute_dtype is None:
+                return batch
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(self.compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, batch)
+
+        def apply_update(state, grads, loss, aux, new_extra):
+            """Shared tail: one optimizer update + next TrainState."""
+            import optax
+
+            updates, new_opt = self.optimizer.update(
+                grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
             return (TrainState(state.step + 1, new_params, new_opt, new_extra),
                     loss, aux)
 
-        self._step_core = train_step
+        def train_step_accum(state, batch, mask):
+            """One optimizer step from ``accum_steps`` sequential microbatch
+            grad passes (lax.scan): grads/loss are mask-weighted means,
+            which equals the full-batch update exactly for masked-MEAN
+            losses (incl. mask-independent terms like weight decay — see
+            the ctor docstring for the contract); BatchNorm stats thread
+            through the microbatches sequentially.  Peak activation memory
+            drops by ~accum_steps."""
+            a = self.accum_steps
+            batch = cast_batch(batch)
+
+            def resh(x):
+                if x.shape[0] % a:
+                    raise ValueError(
+                        "batch dim {} not divisible by accum_steps {}".format(
+                            x.shape[0], a))
+                return x.reshape((a, x.shape[0] // a) + x.shape[1:])
+
+            micro = jax.tree_util.tree_map(resh, batch)
+            micro_mask = resh(mask)
+            zero_g = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            zero = jnp.zeros((), jnp.float32)
+
+            def body(carry, bm):
+                g_acc, l_acc, w_acc, extra = carry
+                b, m = bm
+                loss, aux, grads, new_extra = grad_micro(
+                    state.params, extra, b, m)
+                w = m.sum()
+                g_acc = jax.tree_util.tree_map(
+                    lambda acc, g: acc + g * w, g_acc, grads)
+                return (g_acc, l_acc + loss * w, w_acc + w, new_extra), aux
+
+            (g_sum, l_sum, w_sum, new_extra), aux_stack = jax.lax.scan(
+                body, (zero_g, zero, zero, state.extra), (micro, micro_mask))
+            w_safe = jnp.maximum(w_sum, 1.0)
+            grads = jax.tree_util.tree_map(lambda x: x / w_safe, g_sum)
+            aux = jax.tree_util.tree_map(lambda x: x[-1], aux_stack)
+            return apply_update(state, grads, l_sum / w_safe, aux, new_extra)
+
+        def train_step(state, batch, mask):
+            loss, aux, grads, new_extra = grad_micro(
+                state.params, state.extra, cast_batch(batch), mask)
+            return apply_update(state, grads, loss, aux, new_extra)
+
+        # _plain_core: the accumulation-free full-batch step — the canonical
+        # unit that MFU accounting is defined on (see _ensure_history).
+        self._plain_core = train_step
+        self._step_core = train_step if accum_steps == 1 else train_step_accum
         self._donate = (0,) if donate else ()
-        self._train_step = jax.jit(train_step, donate_argnums=self._donate)
+        self._train_step = jax.jit(self._step_core,
+                                   donate_argnums=self._donate)
         self._multi_cache = {}  # k -> jitted k-step scan program
         self.history = None
 
@@ -160,16 +230,34 @@ class Trainer(object):
                 repeat, donate_argnums=self._donate)
         return self._multi_cache[key]
 
-    def _ensure_history(self, fn, args, steps_per_dispatch=1):
-        """Lazily build the metrics recorder from ``fn``'s XLA cost analysis.
+    def _ensure_history(self, example_batch, example_mask, stacked=False):
+        """Lazily build the metrics recorder with per-step FLOPs.
 
-        XLA's HloCostAnalysis counts a while/scan body ONCE (trip count is
-        not multiplied — verified empirically: a scan-of-4 program reports
-        1.0x the single-step flops), so the cost of a K-step scan program
-        IS the per-step cost; dividing by K would under-state MFU by ~K."""
-        del steps_per_dispatch  # per-dispatch cost == per-step cost, above
+        FLOPs always come from cost-analyzing the CANONICAL program — the
+        accumulation-free full-batch single step (``_plain_core``) — never
+        the dispatched scan variant: XLA's HloCostAnalysis is inconsistent
+        about while/scan bodies (measured on one backend: an xs=None scan
+        counted its body once, a microbatch-accumulation scan counted it
+        per-trip), so deriving per-step cost from a scan program is
+        guesswork.  The canonical program is lowered with abstract inputs
+        (compile-only, never executed; the persistent compile cache dedups
+        it across processes).
+
+        ``stacked=True``: the examples carry a leading scan dim — strip it
+        into ShapeDtypeStructs sharded like a single fed batch."""
         if self.history is None:
-            flops = metrics_mod.estimate_step_flops(fn, self.state, *args)
+            if stacked:
+                shard = mesh_mod.batch_sharding(self.mesh)
+
+                def strip(x):
+                    return jax.ShapeDtypeStruct(x.shape[1:], x.dtype,
+                                                sharding=shard)
+
+                example_batch = jax.tree_util.tree_map(strip, example_batch)
+                example_mask = jax.tree_util.tree_map(strip, example_mask)
+            flops = metrics_mod.estimate_step_flops(
+                jax.jit(self._plain_core), self.state,
+                example_batch, example_mask)
             self.history = metrics_mod.TimeHistory(
                 batch_size=self.batch_size or 0, log_steps=self.log_steps,
                 step_flops=flops)
@@ -179,7 +267,7 @@ class Trainer(object):
         """Run ``k`` steps on one batch in a single dispatch; returns the
         final step's loss."""
         fn = self._get_repeat_step(k)
-        self._ensure_history(fn, (batch, mask), steps_per_dispatch=k)
+        self._ensure_history(batch, mask)
         self.state, loss = fn(self.state, batch, mask)
         self.history.on_steps_end(k, loss)
         return loss
@@ -191,19 +279,15 @@ class Trainer(object):
         Returns the final step's loss."""
         k = int(jax.tree_util.tree_leaves(masks)[0].shape[0])
         fn = self._get_multi_step(k)
-        self._ensure_history(fn, (batches, masks), steps_per_dispatch=k)
+        self._ensure_history(batches, masks, stacked=True)
         self.state, loss = fn(self.state, batches, masks)
         self.history.on_steps_end(k, loss)
         return loss
 
     def compile_and_measure(self, example_batch, example_mask):
         """Lower/compile once and capture per-step FLOPs for MFU reporting."""
-        flops = metrics_mod.estimate_step_flops(
-            self._train_step, self.state, example_batch, example_mask)
-        self.history = metrics_mod.TimeHistory(
-            batch_size=self.batch_size or 0, log_steps=self.log_steps,
-            step_flops=flops)
-        return flops
+        self._ensure_history(example_batch, example_mask)
+        return self.history.step_flops
 
     def reset_history(self):
         """Replace the metrics recorder with a fresh one (same measured step
@@ -220,7 +304,7 @@ class Trainer(object):
         if mask is None:
             first = jax.tree_util.tree_leaves(batch)[0]
             mask = jnp.ones((first.shape[0],), jnp.float32)
-        self._ensure_history(self._train_step, (batch, mask))
+        self._ensure_history(batch, mask)
         self.state, loss, aux = self._train_step(self.state, batch, mask)
         # Passing the loss lets TimeHistory sync on device completion at
         # window boundaries (honest ms/step + MFU under async dispatch);

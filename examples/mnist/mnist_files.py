@@ -23,53 +23,29 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
 def main_fun(args, ctx):
     import jax
-    import jax.numpy as jnp
     import numpy as np
-    import optax
 
-    from tensorflowonspark_tpu import checkpoint, dfutil
-    from tensorflowonspark_tpu import train as train_mod
-    from tensorflowonspark_tpu.models import mnist as mnist_mod
     from tensorflowonspark_tpu.parallel import mesh as mesh_mod
 
     ctx.initialize_distributed()
     mesh = mesh_mod.build_mesh()
 
     # Each process reads + shards the dataset itself (FILES mode contract).
+    # With a data_dir, shards STREAM through data.FileFeed (reader threads,
+    # shuffle buffer, executor-side epochs — the tf.data role) instead of
+    # loading the dataset into memory; see train_streaming below.
     if args.data_dir:
-        rows = dfutil.load_tfrecords(os.path.join(args.data_dir, "train"))
-        images = np.asarray([r["image"] for r in rows], np.float32)
-        labels = np.asarray([r["label"] for r in rows], np.int32)
-    else:
-        from mnist_data_setup import synthetic_mnist
+        return train_streaming(args, ctx, mesh)
+    from mnist_data_setup import synthetic_mnist
 
-        raw, labels = synthetic_mnist("train")
-        images = (raw / 255.0).astype(np.float32)
-        labels = labels.astype(np.int32)
+    raw, labels = synthetic_mnist("train")
+    images = (raw / 255.0).astype(np.float32)
+    labels = labels.astype(np.int32)
     images = images.reshape(-1, 28, 28, 1)
     shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
     images, labels = images[shard], labels[shard]
 
-    model = mnist_mod.build_mnist(dtype="bfloat16")
-    params = model.init(jax.random.PRNGKey(0),
-                        jnp.zeros((1, 28, 28, 1)))["params"]
-    trainer = train_mod.Trainer(
-        mnist_mod.loss_fn(model), params,
-        optax.sgd(args.lr, momentum=0.9), mesh=mesh,
-        compute_dtype=jnp.bfloat16, batch_size=args.batch_size)
-
-    ckpt = None
-    if args.model_dir:
-        ckpt = checkpoint.CheckpointManager(
-            ctx.absolute_path(args.model_dir),
-            save_interval_steps=args.save_interval)
-        state, step = ckpt.restore_latest(
-            jax.tree_util.tree_map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-                trainer.state))
-        if state is not None:
-            trainer.state = jax.device_put(state,
-                                           mesh_mod.replicated(mesh))
+    trainer, ckpt = _build_trainer(args, ctx, mesh)
 
     local_bs = mesh_mod.local_batch_size(mesh, args.batch_size)
     sharding = mesh_mod.batch_sharding(mesh)
@@ -99,6 +75,50 @@ def main_fun(args, ctx):
 
     trainer.history.on_train_end(loss)
     stats = trainer.history.log_stats(loss=float(loss))
+    _finish(args, ctx, trainer, ckpt, step_count)
+    return stats
+
+
+def _build_trainer(args, ctx, mesh):
+    """Model + Trainer + optional CheckpointManager with restore-on-restart
+    (shared by the in-memory and streaming paths)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import mnist as mnist_mod
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    model = mnist_mod.build_mnist(dtype="bfloat16")
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    trainer = train_mod.Trainer(
+        mnist_mod.loss_fn(model), params,
+        optax.sgd(args.lr, momentum=0.9), mesh=mesh,
+        compute_dtype=jnp.bfloat16, batch_size=args.batch_size)
+
+    ckpt = None
+    if args.model_dir:
+        ckpt = checkpoint.CheckpointManager(
+            ctx.absolute_path(args.model_dir),
+            save_interval_steps=args.save_interval)
+        state, _ = ckpt.restore_latest(
+            jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                trainer.state))
+        if state is not None:
+            trainer.state = jax.device_put(state,
+                                           mesh_mod.replicated(mesh))
+    return trainer, ckpt
+
+
+def _finish(args, ctx, trainer, ckpt, step_count):
+    import jax
+
+    from tensorflowonspark_tpu import checkpoint
+
     if ckpt:
         ckpt.maybe_save(step_count, trainer.state, force=True)
         ckpt.wait_until_finished()
@@ -109,6 +129,45 @@ def main_fun(args, ctx):
             jax.device_get(trainer.state.params), "mnist_cnn",
             model_config={"dtype": "bfloat16"},
             input_signature={"image": [None, 28, 28, 1]})
+
+
+def train_streaming(args, ctx, mesh):
+    """data.FileFeed -> ShardedFeed -> Trainer.fit_feed: TFRecord shards
+    stream through reader threads + shuffle buffer + executor-side epochs
+    (the tf.data role, reference ``mnist_tf.py:23-27``) with the same
+    device plane as SPARK mode (prefetch, consensus, K-step groups)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu import data as data_mod
+    from tensorflowonspark_tpu.datafeed import strip_scheme
+    from tensorflowonspark_tpu.parallel import infeed
+
+    import jax
+
+    trainer, ckpt = _build_trainer(args, ctx, mesh)
+    root = strip_scheme(ctx.absolute_path(args.data_dir))
+    feed = data_mod.FileFeed(
+        data_mod.list_shards(os.path.join(root, "train")),
+        shuffle_buffer=args.shuffle_buffer, num_epochs=args.epochs,
+        seed=jax.process_index())
+
+    def transform(cols):
+        return {
+            "image": np.asarray(cols["image"],
+                                np.float32).reshape(-1, 28, 28, 1),
+            "label": np.asarray(cols["label"], np.int32),
+        }
+
+    sharded = infeed.ShardedFeed(feed, mesh, args.batch_size,
+                                 transform=transform)
+    # Periodic checkpointing rides the per-dispatch hook (save_interval is
+    # enforced by the manager; off-interval calls are free no-ops).
+    on_steps = ((lambda s: ckpt.maybe_save(s, trainer.state)) if ckpt
+                else None)
+    stats = trainer.fit_feed(sharded, max_steps=args.max_steps,
+                             steps_per_call=args.steps_per_call,
+                             on_steps=on_steps)
+    _finish(args, ctx, trainer, ckpt, int(trainer.state.step))
     return stats
 
 
@@ -121,6 +180,11 @@ def main(argv=None):
     parser.add_argument("--epochs", type=int, default=3)
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--max_steps", type=int, default=None)
+    parser.add_argument("--steps_per_call", type=int, default=1,
+                        help="train steps per device dispatch (streaming "
+                             "path)")
+    parser.add_argument("--shuffle_buffer", type=int, default=4096,
+                        help="FileFeed shuffle reservoir (streaming path)")
     parser.add_argument("--save_interval", type=int, default=100)
     parser.add_argument("--data_dir", default=None,
                         help="TFRecord root from mnist_data_setup.py "

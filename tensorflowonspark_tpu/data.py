@@ -1,0 +1,266 @@
+"""FILES-mode input pipeline: the tf.data equivalent for this framework.
+
+The reference's ``InputMode.TENSORFLOW`` workers read their own data with
+``tf.data`` (``ds.shard(num_workers, worker_num)``, shuffle, batch, prefetch
+— reference ``examples/mnist/keras/mnist_tf.py:23-27``,
+``examples/resnet/imagenet_preprocessing.py``).  This module provides that
+role TPU-first, with no TensorFlow:
+
+:class:`FileFeed` streams TFRecord shards through background reader threads
+into columnar numpy batches, with file-level process sharding, a shuffle
+buffer, and executor-side epochs.  It duck-types the
+:class:`~tensorflowonspark_tpu.datafeed.DataFeed` consumer interface
+(``next_batch_arrays`` / ``should_stop`` / ``interrupt`` / ``terminate``),
+so :class:`~tensorflowonspark_tpu.parallel.infeed.ShardedFeed` composes
+unchanged on top — device transfer, prefetch double-buffering, cross-host
+end-of-data consensus, and K-step ``grouped_batches`` all work identically
+for SPARK-pushed and file-read data.
+
+Typical use inside ``main_fun``::
+
+    feed = data.FileFeed(data.list_shards(args.data_dir),
+                         shuffle_buffer=10000, num_epochs=args.epochs,
+                         seed=ctx.process_id)
+    sharded = infeed.ShardedFeed(feed, mesh, args.batch_size,
+                                 transform=to_model_batch)
+    trainer.fit_feed(sharded, steps_per_call=8)
+"""
+
+import glob as _glob
+import logging
+import os
+import queue as _queue
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_END = object()          # reader-side end-of-stream marker
+_INTERRUPTED = object()
+
+
+def list_shards(path, pattern="part-*"):
+    """Sorted shard files under ``path`` (a dir, a glob, or a single file).
+
+    Directory case falls back from ``pattern`` to ``*.tfrecord*`` — the
+    same lookup ``dfutil.load_tfrecords`` uses, so dirs with either naming
+    convention work."""
+    if os.path.isdir(path):
+        files = (sorted(_glob.glob(os.path.join(path, pattern)))
+                 or sorted(_glob.glob(os.path.join(path, "*.tfrecord*"))))
+    else:
+        files = sorted(_glob.glob(path)) or [path]
+    if not files:
+        raise FileNotFoundError("no shard files at {!r}".format(path))
+    return files
+
+
+def shard_for_process(files, process_index=None, process_count=None):
+    """File-level sharding (the reference's ``ds.shard``): every process
+    reads ``files[process_index::process_count]``.  With fewer files than
+    processes, falls back to giving every process the full list with a
+    warning (record-level sharding would be needed for true disjointness)."""
+    if process_index is None:
+        import jax
+
+        process_index = jax.process_index()
+        process_count = jax.process_count()
+    if len(files) < process_count:
+        logger.warning(
+            "%d shard files < %d processes: every process reads all files "
+            "(write more shards for disjoint reads)", len(files),
+            process_count)
+        return list(files)
+    return list(files)[process_index::process_count]
+
+
+def tfrecord_rows(path, binary_features=(), schema=None):
+    """Generator of parsed row dicts from one TFRecord file (native codec
+    with pure-python fallback; schema inference as in dfutil)."""
+    from tensorflowonspark_tpu import dfutil, tfrecord
+
+    inferred = schema
+    for rec in tfrecord.tfrecord_iterator(path):
+        if inferred is None:
+            inferred = dfutil.infer_schema(rec, binary_features)
+        yield dfutil.from_example(rec, inferred)
+
+
+class FileFeed(object):
+    """Streaming columnar batches from record files (FILES mode).
+
+    Args:
+      files: shard file list (see :func:`list_shards`); pass the FULL list —
+        process sharding is applied here (``shard=False`` to disable).
+      row_reader: ``fn(path) -> iterator of rows`` (defaults to
+        :func:`tfrecord_rows`).  Rows may be dicts (columnar by key),
+        tuples, or single values — the same row shapes DataFeed handles.
+      shuffle_buffer: >0 enables a uniform reservoir shuffle of that size.
+      num_epochs: passes over the files (readers re-open per epoch);
+        epoch boundaries are invisible to the consumer (like executor-side
+        epoch replay in SPARK mode).
+      reader_threads: concurrent shard readers (each owns whole files).
+      seed: shuffle seed (vary per process for decorrelated shards).
+      shard: apply :func:`shard_for_process` to the file list.
+      queue_size: reader->consumer row-block queue depth (backpressure).
+    """
+
+    BLOCK = 256  # rows per reader->consumer handoff (amortizes queue ops)
+
+    def __init__(self, files, row_reader=None, shuffle_buffer=0,
+                 num_epochs=1, reader_threads=2, seed=0, shard=True,
+                 queue_size=64):
+        self.files = (shard_for_process(files) if shard else list(files))
+        self.row_reader = row_reader or tfrecord_rows
+        self.shuffle_buffer = shuffle_buffer
+        self.num_epochs = num_epochs
+        self.reader_threads = max(1, min(reader_threads, len(self.files)))
+        self._rng = np.random.default_rng(seed)
+        self._queue = _queue.Queue(maxsize=queue_size)
+        self._interrupt = threading.Event()
+        self._done = False        # consumer-side end-of-stream latch
+        self._reservoir = []
+        self._pending = []       # rows spilled past the last batch boundary
+        self._ends = 0           # end-markers consumed (persists across calls)
+        self._started = False
+        self._threads = []
+        self._errors = _queue.Queue()
+
+    # -- reader side -------------------------------------------------------
+
+    def _reader(self, worker_idx):
+        try:
+            block = []
+            for epoch in range(self.num_epochs):
+                for path in self.files[worker_idx::self.reader_threads]:
+                    for row in self.row_reader(path):
+                        block.append(row)
+                        if len(block) >= self.BLOCK:
+                            if not self._put(block):
+                                return
+                            block = []
+            if block:
+                self._put(block)
+        except BaseException as exc:  # noqa: B036 — relayed to the consumer
+            self._errors.put(exc)
+        finally:
+            self._put(_END, force=True)
+
+    def _put(self, item, force=False):
+        while not self._interrupt.is_set():
+            try:
+                self._queue.put(item, timeout=0.2)
+                return True
+            except _queue.Full:
+                continue
+        if force:
+            # unblock the consumer's end-of-stream accounting even when
+            # interrupted: drop queued data, push the marker best-effort
+            try:
+                self._queue.put_nowait(item)
+            except _queue.Full:
+                pass
+        return False
+
+    def _ensure_started(self):
+        if self._started:
+            return
+        self._started = True
+        for i in range(self.reader_threads):
+            t = threading.Thread(target=self._reader, args=(i,),
+                                 name="filefeed-reader-%d" % i, daemon=True)
+            self._threads.append(t)
+            t.start()
+
+    # -- consumer side (DataFeed duck type) --------------------------------
+
+    def _next_rows(self):
+        """One reader block through the shuffle reservoir; None at end."""
+        while True:
+            if not self._errors.empty():
+                raise self._errors.get()
+            if self._interrupt.is_set():
+                return None
+            if self._ends >= len(self._threads):
+                break  # every reader already finished (latched)
+            try:
+                item = self._queue.get(timeout=0.5)
+            except _queue.Empty:
+                continue
+            if item is _END:
+                self._ends += 1
+                if self._ends >= len(self._threads):
+                    break
+                continue
+            if not self.shuffle_buffer:
+                return item
+            # reservoir: absorb the block, emit uniformly-sampled rows once
+            # the buffer is warm
+            self._reservoir.extend(item)
+            if len(self._reservoir) >= self.shuffle_buffer + self.BLOCK:
+                idx = self._rng.choice(len(self._reservoir), self.BLOCK,
+                                       replace=False)
+                out = [self._reservoir[i] for i in idx]
+                for i in sorted(idx, reverse=True):
+                    self._reservoir[i] = self._reservoir[-1]
+                    self._reservoir.pop()
+                return out
+        # drain the reservoir at end-of-stream
+        if self._reservoir:
+            out = self._reservoir
+            self._reservoir = []
+            self._rng.shuffle(out)
+            return out
+        return None
+
+    def next_batch_arrays(self, batch_size, dtypes=None):
+        """Columnar ``(arrays, count)`` — same contract as
+        ``DataFeed.next_batch_arrays`` (dict of columns for dict rows,
+        tuple of columns for tuple rows, single array otherwise)."""
+        self._ensure_started()
+        rows = self._pending
+        self._pending = []
+        while len(rows) < batch_size:
+            block = self._next_rows()
+            if block is None:
+                self._done = True
+                break
+            rows.extend(block)
+        if len(rows) > batch_size:
+            self._pending = rows[batch_size:]
+            rows = rows[:batch_size]
+        if not rows:
+            return np.empty((0,)), 0
+        return self._columnar(rows, dtypes), len(rows)
+
+    @staticmethod
+    def _columnar(rows, dtypes):
+        first = rows[0]
+        if isinstance(first, dict):
+            return {
+                k: np.asarray([r[k] for r in rows],
+                              dtype=None if not dtypes else dtypes.get(k))
+                for k in first
+            }
+        if isinstance(first, tuple):
+            return tuple(
+                np.asarray([r[f] for r in rows],
+                           dtype=None if not dtypes else dtypes[f])
+                for f in range(len(first)))
+        return np.asarray(rows, dtype=None if not dtypes else dtypes)
+
+    def should_stop(self):
+        return self._done and not self._pending
+
+    def interrupt(self):
+        self._interrupt.set()
+
+    def terminate(self):
+        """Stop readers and drop buffered data (early stop)."""
+        self._interrupt.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._reservoir = []
+        self._pending = []
+        self._done = True

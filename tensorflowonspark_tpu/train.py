@@ -72,7 +72,11 @@ class Trainer(object):
         full-batch update EXACTLY for masked-MEAN losses
         (``masked_sum / mask.sum()`` plus mask-independent terms like
         weight decay — the form every framework loss uses); a masked-SUM
-        loss would instead see its microbatch grads reweighted.
+        loss would instead see its microbatch grads reweighted.  Note the
+        ``aux`` returned by :meth:`step` is the LAST microbatch's aux only
+        (auxes are not averaged — they may be arbitrary pytrees), so
+        aux-derived metrics like accuracy sample 1/accum_steps of the
+        batch; the loss itself IS the full-batch value.
     """
 
     def __init__(self, loss_fn, init_params, optimizer, mesh=None,
@@ -312,7 +316,8 @@ class Trainer(object):
         self.history.on_step_end(loss)
         return loss, aux
 
-    def fit_feed(self, sharded_feed, max_steps=None, steps_per_call=1):
+    def fit_feed(self, sharded_feed, max_steps=None, steps_per_call=1,
+                 on_steps=None):
         """Train from a :class:`~tensorflowonspark_tpu.parallel.infeed.ShardedFeed`
         until end-of-data consensus (or ``max_steps``); returns final stats.
 
@@ -324,7 +329,13 @@ class Trainer(object):
         (:meth:`ShardedFeed.grouped_batches`) and runs each group as one
         ``lax.scan`` dispatch (:meth:`multi_step`); tail batches that can't
         fill a group run as ordinary single steps.  ``max_steps`` may be
-        overshot by at most K-1 steps."""
+        overshot by at most K-1 steps.
+
+        ``on_steps``: optional ``fn(steps_done)`` called after every
+        dispatch (so once per K-step group) — the hook for periodic
+        checkpointing: ``on_steps=lambda s: ckpt.maybe_save(s,
+        trainer.state)`` (reading ``trainer.state`` there doesn't sync; the
+        manager pulls values only when the interval fires)."""
         last_loss = None
         # Host-side step counter: reading state.step would sync on the
         # just-dispatched device step and defeat the infeed's double
@@ -342,6 +353,8 @@ class Trainer(object):
                 loss, _ = self.step(batch, mask)
                 steps_done += 1
             last_loss = loss
+            if on_steps is not None:
+                on_steps(steps_done)
             if max_steps and steps_done >= max_steps:
                 # Early stop with epochs of data still queued: drain it so
                 # blocked feed tasks unblock and the driver stops scheduling

@@ -129,3 +129,19 @@ def test_mnist_eval_node(tmp_path):
                        "--save_interval", "10",
                        "--model_dir", str(tmp_path / "ckpt")])
     assert "evaluator: step 20" in out
+
+
+@pytest.mark.slow
+def test_mnist_files_streaming_tfrecords(tmp_path):
+    """FILES mode streaming path: stage TFRecord shards, then train from
+    them through data.FileFeed -> ShardedFeed with grouped dispatch."""
+    data_root = str(tmp_path / "mnist")
+    run_example("mnist/mnist_data_setup.py",
+                ["--output", data_root, "--format", "tfr",
+                 "--num_partitions", "4"])
+    out = run_example("mnist/mnist_files.py",
+                      ["--cluster_size", "2", "--epochs", "1",
+                       "--batch_size", "128", "--max_steps", "6",
+                       "--steps_per_call", "2", "--shuffle_buffer", "512",
+                       "--data_dir", os.path.join(data_root, "tfr")])
+    assert "train stats" in out

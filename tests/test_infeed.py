@@ -217,3 +217,31 @@ def test_fit_feed_steps_per_call_trains_all_steps(mgr):
     stats = tr.fit_feed(sf, steps_per_call=2)
     assert stats["global_steps"] == 5  # 40 rows / batch 8: 2 groups + 1 single
     assert "loss" in stats
+
+
+def test_fit_feed_on_steps_hook(mgr):
+    """on_steps fires once per dispatch with the running step count — the
+    periodic-checkpoint hook."""
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(32):
+        x = [float(v) for v in rng.rand(2)]
+        rows.append((x, float(np.dot(x, [3.14, 1.618]))))
+    _fill(mgr, rows)
+    feed = DataFeed(mgr, input_mapping={"a_x": "x", "b_y": "y"})
+    mesh = build_mesh()
+    sf = ShardedFeed(feed, mesh, global_batch_size=8, prefetch=0)
+
+    from tensorflowonspark_tpu.train import Trainer
+    import jax.numpy as jnp
+
+    def loss(params, batch, mask):
+        pred = jnp.asarray(batch["x"]) @ params["w"]
+        err = (pred - jnp.asarray(batch["y"])) ** 2 * mask
+        return err.sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+    tr = Trainer(loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1), mesh=mesh,
+                 batch_size=8, log_steps=10)
+    seen = []
+    tr.fit_feed(sf, steps_per_call=2, on_steps=seen.append)
+    assert seen == [2, 4]  # one call per 2-step group dispatch

@@ -1,0 +1,149 @@
+"""ImageNet TFRecord input for the ResNet example (no TensorFlow, no JVM).
+
+The reference reads ImageNet from the standard TFRecord shards with
+``tf.data`` + TF image ops (reference ``examples/resnet/
+imagenet_preprocessing.py``: parse Example -> decode JPEG -> random
+resized crop + horizontal flip (train) / resize + center crop (eval) ->
+channel-mean subtraction).  This module is that pipeline rebuilt for the
+TPU framework:
+
+- ``imagenet_reader`` is a ``data.FileFeed`` row reader: native TFRecord
+  codec -> tf.train.Example wire parse -> PIL JPEG decode -> numpy crops.
+- Rows leave as **uint8 HWC** — 1 byte/pixel across the host->device link;
+  the channel-mean normalization belongs ON DEVICE inside the jitted step
+  (see :func:`normalize_on_device`), which is both faster and exact.
+
+Standard shard feature keys (same as the reference's ``_parse_example_proto``,
+``imagenet_preprocessing.py``): ``image/encoded`` (JPEG bytes),
+``image/class/label`` (int, 1-based in the classic shards).
+"""
+
+import io
+
+import numpy as np
+
+# Reference channel means (imagenet_preprocessing.py CHANNEL_MEANS),
+# subtracted on device after the uint8 batch lands.
+CHANNEL_MEANS = (123.68, 116.779, 103.939)
+
+
+def _decode_jpeg(data):
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data))
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    return img
+
+
+def random_resized_crop(img, size, rng, scale=(0.08, 1.0),
+                        ratio=(3 / 4, 4 / 3), attempts=10):
+    """Train-time crop (reference ``_decode_crop_and_flip``): sample a
+    random area/aspect window, fall back to a center crop when no sample
+    fits, resize to ``size`` x ``size``."""
+    from PIL import Image
+
+    w, h = img.size
+    area = w * h
+    for _ in range(attempts):
+        target = area * rng.uniform(*scale)
+        ar = np.exp(rng.uniform(np.log(ratio[0]), np.log(ratio[1])))
+        cw = int(round(np.sqrt(target * ar)))
+        ch = int(round(np.sqrt(target / ar)))
+        if 0 < cw <= w and 0 < ch <= h:
+            x = rng.integers(0, w - cw + 1)
+            y = rng.integers(0, h - ch + 1)
+            box = (x, y, x + cw, y + ch)
+            return img.resize((size, size), Image.BILINEAR, box=box)
+    return center_crop(img, size)
+
+
+def center_crop(img, size, resize_shorter=256):
+    """Eval-time crop (reference ``_central_crop`` + aspect-preserving
+    resize): shorter side to ``resize_shorter``, central ``size`` window."""
+    from PIL import Image
+
+    w, h = img.size
+    scale = resize_shorter / min(w, h)
+    img = img.resize((max(1, int(round(w * scale))),
+                      max(1, int(round(h * scale)))), Image.BILINEAR)
+    w, h = img.size
+    x = (w - size) // 2
+    y = (h - size) // 2
+    return img.crop((x, y, x + size, y + size))
+
+
+def imagenet_reader(train=True, image_size=224, seed=0,
+                    label_offset=-1):
+    """Returns a ``data.FileFeed`` row reader for ImageNet TFRecord shards.
+
+    Yields ``{"image": uint8 (H, W, 3), "label": int32}`` rows.
+    ``label_offset=-1`` maps the classic shards' 1-based labels to 0-based.
+    """
+    def reader(path):
+        import zlib
+
+        from tensorflowonspark_tpu import example_proto, tfrecord
+
+        # stable per-file stream (hash() is process-randomized; crc32 isn't)
+        rng = np.random.default_rng((seed, zlib.crc32(path.encode())))
+        for rec in tfrecord.tfrecord_iterator(path):
+            feats = example_proto.decode_example(rec)
+            _, encoded = feats["image/encoded"]
+            _, label = feats["image/class/label"]
+            img = _decode_jpeg(encoded[0])
+            if train:
+                img = random_resized_crop(img, image_size, rng)
+                if rng.random() < 0.5:
+                    img = img.transpose(0)  # FLIP_LEFT_RIGHT
+            else:
+                img = center_crop(img, image_size)
+            yield {
+                "image": np.asarray(img, np.uint8),
+                "label": np.int32(int(label[0]) + label_offset),
+            }
+
+    return reader
+
+
+def normalize_on_device(image_batch, dtype=None):
+    """uint8 device batch -> ``dtype`` (default bf16) with reference
+    channel-mean subtraction; call INSIDE the jitted loss/step so the
+    host->device link carries 1 byte/pixel."""
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    means = jnp.asarray(CHANNEL_MEANS, dtype)
+    return image_batch.astype(dtype) - means
+
+
+def write_synthetic_shards(out_dir, num_examples=64, num_shards=4,
+                           image_size=64, num_classes=1000, seed=0):
+    """Stage tiny synthetic ImageNet-format TFRecord shards (random JPEGs,
+    1-based labels) — for tests and smoke runs without the real dataset."""
+    import os
+
+    from tensorflowonspark_tpu import example_proto, tfrecord
+    from PIL import Image
+
+    os.makedirs(out_dir, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    per = max(1, num_examples // num_shards)
+    n = 0
+    for s in range(num_shards):
+        path = os.path.join(out_dir, "train-{:05d}-of-{:05d}".format(
+            s, num_shards))
+        with tfrecord.TFRecordWriter(path) as w:
+            for _ in range(per):
+                arr = rng.integers(0, 256, (image_size, image_size, 3),
+                                   np.uint8)
+                buf = io.BytesIO()
+                Image.fromarray(arr).save(buf, format="JPEG")
+                rec = example_proto.encode_example({
+                    "image/encoded": ("bytes", [buf.getvalue()]),
+                    "image/class/label":
+                        ("int64", [int(rng.integers(1, num_classes + 1))]),
+                })
+                w.write(rec)
+                n += 1
+    return n

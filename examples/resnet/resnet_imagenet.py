@@ -66,9 +66,10 @@ def main_fun(args, ctx):
     mesh = mesh_mod.build_mesh()
     size = args.image_size
 
-    images, labels = synthetic_imagenet(args.synthetic_examples, size)
-    shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
-    images, labels = images[shard], labels[shard]
+    if not args.data_dir:
+        images, labels = synthetic_imagenet(args.synthetic_examples, size)
+        shard = slice(jax.process_index(), None, max(jax.process_count(), 1))
+        images, labels = images[shard], labels[shard]
 
     # blocks_per_stage is the size knob (the reference's resnet_size):
     # None -> ResNet-50's [3,4,6,3]; 1 -> a 14-layer smoke model.
@@ -94,9 +95,27 @@ def main_fun(args, ctx):
         [warmup_steps])
     optimizer = optax.sgd(schedule, momentum=0.9)
 
+    base_loss = resnet_mod.loss_fn(model, weight_decay=args.weight_decay,
+                                   label_smoothing=args.label_smoothing)
+    if args.data_dir:
+        # TFRecord rows arrive uint8 (1 byte/pixel over the host->device
+        # link); the reference's channel-mean normalization happens HERE,
+        # inside the jitted step (imagenet_preprocessing.py equivalent).
+        import imagenet_input
+
+        _in_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
+                     else jnp.float32)
+
+        def loss(p, bs, batch, mask):
+            batch = dict(batch)
+            batch["image"] = imagenet_input.normalize_on_device(
+                batch["image"], _in_dtype)
+            return base_loss(p, bs, batch, mask)
+    else:
+        loss = base_loss
+
     trainer = train_mod.Trainer(
-        resnet_mod.loss_fn(model, weight_decay=args.weight_decay,
-                           label_smoothing=args.label_smoothing),
+        loss,
         params, optimizer, mesh=mesh, extra_state=batch_stats,
         compute_dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
         batch_size=args.batch_size, log_steps=args.log_steps)
@@ -113,6 +132,51 @@ def main_fun(args, ctx):
 
         prof = profiler.StepProfiler(
             args.profile_dir or "profile_logs", args.profile_steps)
+
+    if args.data_dir:
+        # Real ImageNet TFRecord shards: stream through data.FileFeed with
+        # the reference's preprocessing (imagenet_input) and the same
+        # device plane as SPARK mode (prefetch, consensus, K-step groups).
+        from tensorflowonspark_tpu import data as data_mod
+        from tensorflowonspark_tpu.datafeed import strip_scheme
+        from tensorflowonspark_tpu.parallel import infeed
+        import imagenet_input
+
+        feed = data_mod.FileFeed(
+            data_mod.list_shards(
+                strip_scheme(ctx.absolute_path(args.data_dir)),
+                pattern="train-*"),
+            row_reader=imagenet_input.imagenet_reader(
+                train=True, image_size=size, seed=jax.process_index()),
+            shuffle_buffer=args.shuffle_buffer,
+            num_epochs=args.train_epochs,
+            reader_threads=args.reader_threads,
+            # decoded 224px uint8 rows are ~147 KB: bound the reader queue
+            # (blocks of FileFeed.BLOCK rows) so it can't buffer gigabytes
+            queue_size=8)
+        sharded = infeed.ShardedFeed(
+            feed, mesh, args.batch_size,
+            transform=lambda cols: {
+                "image": np.asarray(cols["image"]),
+                "label": np.asarray(cols["label"], np.int32)})
+
+        def on_steps(s):
+            if ckpt:
+                ckpt.maybe_save(s, trainer.state)
+            if prof:
+                # dispatch granularity: a K-step group counts as one hop
+                prof.on_step_end()
+                prof.on_step_begin()
+
+        if prof:
+            prof.on_step_begin()
+        stats = trainer.fit_feed(sharded, max_steps=total_steps,
+                                 steps_per_call=args.steps_per_call,
+                                 on_steps=on_steps)
+        if prof:
+            prof.stop()
+        _finish(args, ctx, trainer, ckpt, int(trainer.state.step), size)
+        return stats
 
     local_bs = mesh_mod.local_batch_size(mesh, args.batch_size)
     sharding = mesh_mod.batch_sharding(mesh)
@@ -149,6 +213,17 @@ def main_fun(args, ctx):
     trainer.history.on_train_end(loss)
     stats = trainer.history.log_stats(
         loss=float(loss), accuracy=float(aux["accuracy"]))
+    _finish(args, ctx, trainer, ckpt, step, size)
+    return stats
+
+
+def _finish(args, ctx, trainer, ckpt, step, size):
+    """Final checkpoint + chief-only export (shared by the synthetic and
+    TFRecord-streaming paths)."""
+    import jax
+
+    from tensorflowonspark_tpu import checkpoint
+
     if ckpt:
         ckpt.maybe_save(step, trainer.state, force=True)
         ckpt.wait_until_finished()
@@ -161,7 +236,6 @@ def main_fun(args, ctx):
                           "blocks_per_stage": args.blocks_per_stage,
                           "stem": args.stem},
             input_signature={"image": [None, size, size, 3]})
-    return stats
 
 
 def main(argv=None):
@@ -190,6 +264,15 @@ def main(argv=None):
                         choices=["float32", "bfloat16"])
     parser.add_argument("--use_synthetic_data", action="store_true")
     parser.add_argument("--synthetic_examples", type=int, default=1024)
+    parser.add_argument("--data_dir", default=None,
+                        help="ImageNet TFRecord shard dir (train-*): "
+                             "streams via data.FileFeed + imagenet_input; "
+                             "synthetic data when omitted")
+    parser.add_argument("--steps_per_call", type=int, default=1,
+                        help="train steps per device dispatch (data_dir "
+                             "path)")
+    parser.add_argument("--shuffle_buffer", type=int, default=10000)
+    parser.add_argument("--reader_threads", type=int, default=4)
     parser.add_argument("--model_dir", default=None)
     parser.add_argument("--export_dir", default=None)
     parser.add_argument("--save_interval", type=int, default=1000)

@@ -145,3 +145,23 @@ def test_mnist_files_streaming_tfrecords(tmp_path):
                        "--steps_per_call", "2", "--shuffle_buffer", "512",
                        "--data_dir", os.path.join(data_root, "tfr")])
     assert "train stats" in out
+
+
+@pytest.mark.slow
+def test_resnet_imagenet_tfrecord_streaming(tmp_path):
+    """Real-data path: JPEG TFRecord shards (imagenet_input synthetic
+    stager) -> FileFeed -> ShardedFeed -> grouped fit, uint8 to device."""
+    sys.path.insert(0, os.path.join(EXAMPLES, "resnet"))
+    import imagenet_input
+
+    shards = str(tmp_path / "shards")
+    n = imagenet_input.write_synthetic_shards(shards, num_examples=64,
+                                              num_shards=4, image_size=64)
+    assert n == 64
+    out = run_example("resnet/resnet_imagenet.py",
+                      ["--cluster_size", "2", "--data_dir", shards,
+                       "--train_steps", "4", "--batch_size", "16",
+                       "--blocks_per_stage", "1", "--image_size", "64",
+                       "--steps_per_call", "2", "--shuffle_buffer", "32",
+                       "--stem", "s2d"])
+    assert "train stats" in out

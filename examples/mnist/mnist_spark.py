@@ -59,8 +59,10 @@ def main_fun(args, ctx):
         preprocess=lambda items: preprocess(items))
     # steps_per_call > 1: K steps per lax.scan dispatch (amortizes host
     # dispatch; tail batches fall back to single steps automatically).
+    # getattr: callers that reuse this fn with their own parser (e.g.
+    # mnist_streaming) may not define the flag.
     stats = trainer.fit_feed(sharded, max_steps=args.max_steps,
-                             steps_per_call=args.steps_per_call)
+                             steps_per_call=getattr(args, "steps_per_call", 1))
 
     if args.export_dir and checkpoint.should_export(ctx):
         checkpoint.export_model(

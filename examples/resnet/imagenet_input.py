@@ -118,7 +118,8 @@ def normalize_on_device(image_batch, dtype=None):
 
 
 def write_synthetic_shards(out_dir, num_examples=64, num_shards=4,
-                           image_size=64, num_classes=1000, seed=0):
+                           image_size=64, num_classes=1000, seed=0,
+                           split="train"):
     """Stage tiny synthetic ImageNet-format TFRecord shards (random JPEGs,
     1-based labels) — for tests and smoke runs without the real dataset."""
     import os
@@ -131,8 +132,8 @@ def write_synthetic_shards(out_dir, num_examples=64, num_shards=4,
     per = max(1, num_examples // num_shards)
     n = 0
     for s in range(num_shards):
-        path = os.path.join(out_dir, "train-{:05d}-of-{:05d}".format(
-            s, num_shards))
+        path = os.path.join(out_dir, "{}-{:05d}-of-{:05d}".format(
+            split, s, num_shards))
         with tfrecord.TFRecordWriter(path) as w:
             for _ in range(per):
                 arr = rng.integers(0, 256, (image_size, image_size, 3),

@@ -175,6 +175,11 @@ def main_fun(args, ctx):
                                  on_steps=on_steps)
         if prof:
             prof.stop()
+        if args.eval_data_dir:
+            acc = _evaluate(args, ctx, mesh, model, trainer, size,
+                            _in_dtype)
+            stats["eval_accuracy_top_1"] = acc
+            print("eval accuracy: {:.4f}".format(acc))
         _finish(args, ctx, trainer, ckpt, int(trainer.state.step), size)
         return stats
 
@@ -213,8 +218,64 @@ def main_fun(args, ctx):
     trainer.history.on_train_end(loss)
     stats = trainer.history.log_stats(
         loss=float(loss), accuracy=float(aux["accuracy"]))
+    if args.eval_data_dir:
+        # eval works from the synthetic-train path too (e.g. evaluating a
+        # restored checkpoint against real validation shards)
+        acc = _evaluate(args, ctx, mesh, model, trainer, size,
+                        jnp.bfloat16 if args.dtype == "bfloat16"
+                        else jnp.float32)
+        stats["eval_accuracy_top_1"] = acc
+        print("eval accuracy: {:.4f}".format(acc))
     _finish(args, ctx, trainer, ckpt, step, size)
     return stats
+
+
+def _evaluate(args, ctx, mesh, model, trainer, size, in_dtype):
+    """Top-1 over the validation shards (reference ``eval_input_fn`` +
+    ``accuracy_top_1``): each process reads its file shard with the eval
+    transform (resize + center crop, BatchNorm running averages); the
+    jitted sums run over the globally-sharded batch, so correct/total are
+    already all-host totals (replicated on every process) — no further
+    cross-host merge is needed."""
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu import data as data_mod
+    from tensorflowonspark_tpu.datafeed import strip_scheme
+    from tensorflowonspark_tpu.parallel import infeed
+    import imagenet_input
+
+    feed = data_mod.FileFeed(
+        data_mod.list_shards(
+            strip_scheme(ctx.absolute_path(args.eval_data_dir)),
+            pattern="validation-*"),
+        row_reader=imagenet_input.imagenet_reader(
+            train=False, image_size=size),
+        reader_threads=args.reader_threads, queue_size=8)
+    sharded = infeed.ShardedFeed(
+        feed, mesh, args.batch_size,
+        transform=lambda cols: {
+            "image": np.asarray(cols["image"]),
+            "label": np.asarray(cols["label"], np.int32)})
+
+    @jax.jit
+    def eval_step(params, batch_stats, batch, mask):
+        logits = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            imagenet_input.normalize_on_device(batch["image"], in_dtype),
+            train=False)
+        correct = ((logits.argmax(-1) == batch["label"]) * mask).sum()
+        return correct, mask.sum()
+
+    correct = total = 0.0
+    # drain="all": exhausted hosts step with zero-mask dummies until every
+    # host finishes, so no validation row is dropped (exact eval).
+    for batch, mask in sharded.batches(drain="all"):
+        c, t = eval_step(trainer.state.params, trainer.state.extra,
+                         batch, mask)
+        correct += float(c)
+        total += float(t)
+    return correct / max(total, 1.0)
 
 
 def _finish(args, ctx, trainer, ckpt, step, size):
@@ -268,6 +329,9 @@ def main(argv=None):
                         help="ImageNet TFRecord shard dir (train-*): "
                              "streams via data.FileFeed + imagenet_input; "
                              "synthetic data when omitted")
+    parser.add_argument("--eval_data_dir", default=None,
+                        help="validation-* shard dir: exact top-1 after "
+                             "training (drain='all', center-crop eval)")
     parser.add_argument("--steps_per_call", type=int, default=1,
                         help="train steps per device dispatch (data_dir "
                              "path)")

@@ -15,21 +15,46 @@ riding ICI is what keeps the step itself device-bound.
 """
 
 
+def _host_allreduce(value, reduce):
+    """Allgather one scalar per process and reduce host-side (the shared
+    core of every helper here); single-process short-circuits to the
+    value itself."""
+    import jax
+
+    if jax.process_count() == 1:
+        return float(value)
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    vals = multihost_utils.process_allgather(
+        jnp.asarray(float(value), jnp.float32))
+    return float(reduce(vals))
+
+
 def all_hosts_agree(local_flag, mesh=None):
     """Global logical-AND of a per-host boolean; True iff every process
     passed True.  ``mesh`` is unused today (host-level implementation, see
     module docstring) and accepted for a future device-collective path."""
     del mesh
-    import jax
-    import jax.numpy as jnp
+    return bool(_host_allreduce(bool(local_flag), lambda v: v.min()))
 
-    if jax.process_count() == 1:
-        return bool(local_flag)
-    from jax.experimental import multihost_utils
 
-    flags = multihost_utils.process_allgather(
-        jnp.asarray(bool(local_flag), dtype=jnp.int32))
-    return bool(flags.min())
+def any_host_has_data(mesh, local_flag):
+    """Global logical-OR of a per-host boolean (the dual of
+    :func:`end_of_data_consensus`): True while ANY process still has data.
+    Used by exact-evaluation draining, where exhausted hosts keep stepping
+    with zero-mask dummies until everyone finishes."""
+    del mesh
+    return bool(_host_allreduce(bool(local_flag), lambda v: v.max()))
+
+
+def host_sum(value):
+    """Sum a per-HOST-LOCAL scalar across all processes.  Only for values
+    each process computed over its OWN data (host-side accumulators, local
+    file stats).  NOT for results of jitted reductions over globally
+    sharded arrays — those are already global and replicated on every
+    process; summing them here would multiply by process_count."""
+    return _host_allreduce(value, lambda v: v.sum())
 
 
 def end_of_data_consensus(mesh, local_has_data):

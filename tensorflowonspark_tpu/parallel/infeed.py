@@ -135,19 +135,42 @@ class ShardedFeed(object):
 
     # -- public iteration -------------------------------------------------
 
-    def batches(self):
+    def batches(self, drain="any"):
         """Generator of ``(batch, mask)`` sharded global batches.
 
         Every host must iterate in lock-step (they all run the same SPMD
         program); the per-step consensus guarantees they agree on when to
-        stop, even when Spark partitions are uneven across hosts.
+        stop, even when partitions are uneven across hosts.
+
+        ``drain`` picks the uneven-tail semantics:
+
+        - ``"any"`` (training default): stop as soon as ANY host runs out —
+          a full global batch exists every step; stragglers' tails drop.
+        - ``"all"`` (exact evaluation): run until EVERY host is exhausted —
+          hosts that ran out keep stepping with a zero-mask dummy batch (a
+          masked copy of their last real batch), so no host's rows are ever
+          dropped.  Requires each host to produce at least one real batch.
         """
+        if drain not in ("any", "all"):
+            raise ValueError(
+                "drain must be 'any' or 'all', got {!r}".format(drain))
         stop = self._stop = threading.Event()
         source = (self._prefetched(stop, self._sharded_iter())
                   if self._prefetch_depth else self._sharded_iter())
+        template = None
         try:
             for item in source:
                 has_data = item is not None
+                if drain == "all":
+                    if has_data:
+                        template = item
+                        if not collectives.any_host_has_data(self.mesh, True):
+                            break  # unreachable, keeps call counts aligned
+                        yield item[0], item[1]
+                    else:
+                        yield from self._drain_dummies(template)
+                        return
+                    continue
                 if not collectives.end_of_data_consensus(self.mesh, has_data):
                     if has_data:
                         logger.info(
@@ -158,6 +181,27 @@ class ShardedFeed(object):
                 yield batch, mask
         finally:
             stop.set()  # wind the prefetch thread down on any exit path
+
+    def _drain_dummies(self, template):
+        """drain="all" epilogue: this host is exhausted — keep the SPMD
+        programs in lock-step with zero-mask dummy steps until every other
+        host is exhausted too."""
+        import jax
+
+        if template is None:
+            # Raise BEFORE joining any collective: joining first would let
+            # the other hosts proceed into their next SPMD step and block
+            # on a cross-host reduction this process never enters.  Failing
+            # fast here propagates through the cluster's error plane.
+            raise RuntimeError(
+                "drain='all' needs at least one local batch to shape "
+                "dummy steps; this host's feed was empty (rebalance "
+                "shards so every process gets data)")
+        zero_mask = None
+        while collectives.any_host_has_data(self.mesh, False):
+            if zero_mask is None:
+                zero_mask = jax.jit(lambda m: m * 0.0)(template[1])
+            yield template[0], zero_mask
 
     def grouped_batches(self, k):
         """Generator of ``("multi", batch_stack, mask_stack)`` groups of K

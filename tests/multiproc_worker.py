@@ -132,6 +132,44 @@ def scenario_grouped(rank, world, tmpdir):
     print("grouped ok", rank, kinds, mask_sums)
 
 
+def scenario_drain_all(rank, world, tmpdir):
+    """batches(drain='all') with uneven feeds: the short host emits
+    zero-mask dummies until the long host finishes — every real row on
+    every host is consumed (exact evaluation), unlike drain='any'."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import manager
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel.infeed import ShardedFeed
+
+    mesh = mesh_mod.build_mesh()
+    global_batch = 8 * world
+    n_rows = 8 if rank == 0 else 20   # rank 0: 1 batch; others: 2.5 batches
+    rows = [[float(rank * 1000 + i)] for i in range(n_rows)]
+    mgr = manager.start(b"mp-drain-%d" % rank, ["input"])
+    q = mgr.get_queue("input")
+    for r in rows:
+        q.put(r)
+    q.put(None)
+
+    sf = ShardedFeed(DataFeed(mgr), mesh, global_batch, prefetch=2)
+    mask_sums = []
+    for batch, mask in sf.batches(drain="all"):
+        mask_sums.append(float(jax.jit(jnp.sum)(mask)))
+    mgr.shutdown()
+
+    # per-step real-row mask totals: step1 full everywhere (8*world), then
+    # rank 0 is exhausted and contributes dummies (0) while the others run
+    # a full batch (step2) and a padded 4-row tail (step3).
+    expected = [8.0 * world, 8.0 * (world - 1), 4.0 * (world - 1)]
+    assert mask_sums == expected, (rank, mask_sums, expected)
+    total = sum(mask_sums)
+    assert total == 8 + 20 * (world - 1), (rank, total)
+    print("drain ok", rank, mask_sums)
+
+
 def scenario_checkpoint(rank, world, tmpdir):
     import jax
     import jax.numpy as jnp
@@ -162,6 +200,7 @@ SCENARIOS = {
     "consensus": scenario_consensus,
     "infeed": scenario_infeed,
     "grouped": scenario_grouped,
+    "drain": scenario_drain_all,
     "checkpoint": scenario_checkpoint,
 }
 

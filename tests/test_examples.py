@@ -158,10 +158,17 @@ def test_resnet_imagenet_tfrecord_streaming(tmp_path):
     n = imagenet_input.write_synthetic_shards(shards, num_examples=64,
                                               num_shards=4, image_size=64)
     assert n == 64
+    val = str(tmp_path / "val")
+    imagenet_input.write_synthetic_shards(val, num_examples=24,
+                                          num_shards=2, image_size=64,
+                                          split="validation")
     out = run_example("resnet/resnet_imagenet.py",
                       ["--cluster_size", "2", "--data_dir", shards,
-                       "--train_steps", "4", "--batch_size", "16",
+                       "--eval_data_dir", val,
+                       "--train_steps", "2", "--batch_size", "16",
                        "--blocks_per_stage", "1", "--image_size", "64",
                        "--steps_per_call", "2", "--shuffle_buffer", "32",
-                       "--stem", "s2d"])
+                       "--stem", "s2d"],
+                      timeout=420)  # 3 programs compile (multi/single/eval)
     assert "train stats" in out
+    assert "eval accuracy:" in out

@@ -74,3 +74,7 @@ class TestMultiProcess:
     def test_orbax_collective_save_restore(self, tmp_path):
         outs = _run_world("checkpoint", tmp_path)
         assert all("checkpoint ok" in o for o in outs)
+
+    def test_drain_all_consumes_every_row(self, tmp_path):
+        outs = _run_world("drain", tmp_path)
+        assert all("drain ok" in o for o in outs)

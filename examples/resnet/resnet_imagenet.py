@@ -97,19 +97,17 @@ def main_fun(args, ctx):
 
     base_loss = resnet_mod.loss_fn(model, weight_decay=args.weight_decay,
                                    label_smoothing=args.label_smoothing)
+    in_dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     if args.data_dir:
         # TFRecord rows arrive uint8 (1 byte/pixel over the host->device
         # link); the reference's channel-mean normalization happens HERE,
         # inside the jitted step (imagenet_preprocessing.py equivalent).
         import imagenet_input
 
-        _in_dtype = (jnp.bfloat16 if args.dtype == "bfloat16"
-                     else jnp.float32)
-
         def loss(p, bs, batch, mask):
             batch = dict(batch)
             batch["image"] = imagenet_input.normalize_on_device(
-                batch["image"], _in_dtype)
+                batch["image"], in_dtype)
             return base_loss(p, bs, batch, mask)
     else:
         loss = base_loss
@@ -175,11 +173,7 @@ def main_fun(args, ctx):
                                  on_steps=on_steps)
         if prof:
             prof.stop()
-        if args.eval_data_dir:
-            acc = _evaluate(args, ctx, mesh, model, trainer, size,
-                            _in_dtype)
-            stats["eval_accuracy_top_1"] = acc
-            print("eval accuracy: {:.4f}".format(acc))
+        _maybe_eval(args, ctx, mesh, model, trainer, size, in_dtype, stats)
         _finish(args, ctx, trainer, ckpt, int(trainer.state.step), size)
         return stats
 
@@ -218,16 +212,19 @@ def main_fun(args, ctx):
     trainer.history.on_train_end(loss)
     stats = trainer.history.log_stats(
         loss=float(loss), accuracy=float(aux["accuracy"]))
-    if args.eval_data_dir:
-        # eval works from the synthetic-train path too (e.g. evaluating a
-        # restored checkpoint against real validation shards)
-        acc = _evaluate(args, ctx, mesh, model, trainer, size,
-                        jnp.bfloat16 if args.dtype == "bfloat16"
-                        else jnp.float32)
-        stats["eval_accuracy_top_1"] = acc
-        print("eval accuracy: {:.4f}".format(acc))
+    _maybe_eval(args, ctx, mesh, model, trainer, size, in_dtype, stats)
     _finish(args, ctx, trainer, ckpt, step, size)
     return stats
+
+
+def _maybe_eval(args, ctx, mesh, model, trainer, size, in_dtype, stats):
+    """Run the exact validation top-1 when --eval_data_dir is set (works
+    from both the synthetic and TFRecord train paths — e.g. evaluating a
+    restored checkpoint against real validation shards)."""
+    if args.eval_data_dir:
+        acc = _evaluate(args, ctx, mesh, model, trainer, size, in_dtype)
+        stats["eval_accuracy_top_1"] = acc
+        print("eval accuracy: {:.4f}".format(acc))
 
 
 def _evaluate(args, ctx, mesh, model, trainer, size, in_dtype):

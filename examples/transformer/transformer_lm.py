@@ -90,11 +90,52 @@ def main_fun(args, ctx):
                                       log_steps=args.log_steps,
                                       step_flops=flops)
     history.on_train_begin()
+
+    feed_batches = None
+    if args.data_dir:
+        # Real text: raw files -> byte-level token stream (vocab 256, no
+        # tokenizer deps) packed to seq_len, streamed via FileFeed and
+        # sequence-sharded through the standard plane (the ShardedFeed
+        # sharding override puts tokens on ("data", "seq")).
+        assert args.vocab_size >= 256, \
+            "--data_dir byte-level LM needs --vocab_size >= 256"
+        from tensorflowonspark_tpu import data as data_mod
+        from tensorflowonspark_tpu.datafeed import strip_scheme
+        from tensorflowonspark_tpu.parallel import infeed
+
+        feed = data_mod.FileFeed(
+            data_mod.list_shards(
+                strip_scheme(ctx.absolute_path(args.data_dir)), pattern="*"),
+            row_reader=data_mod.byte_lm_reader(args.seq_len),
+            shuffle_buffer=args.shuffle_buffer, num_epochs=args.epochs,
+            seed=jax.process_index())
+        sharded = infeed.ShardedFeed(feed, mesh, args.batch_size,
+                                     sharding=batch_sharding)
+        feed_batches = sharded.batches()
+
+    l = None
     with mesh:
         for _ in range(args.train_steps):
-            tokens, mask = next_batch()
+            if feed_batches is not None:
+                try:
+                    batch, mask = next(feed_batches)
+                except StopIteration:
+                    break
+                tokens = batch["tokens"]
+            else:
+                tokens, mask = next_batch()
             params, opt_state, l = step_fn(params, opt_state, tokens, mask)
             history.on_step_end(l)
+    if feed_batches is not None:
+        # early-exit protocol (mirrors Trainer.fit_feed): stop the prefetch
+        # and reader threads instead of letting them decode/transfer
+        # batches through the export epilogue
+        sharded.terminate()
+        feed_batches.close()
+    if l is None:
+        raise RuntimeError(
+            "no training batches produced — are the --data_dir files "
+            "shorter than --seq_len bytes?")
     lval = float(l)
     history.on_train_end(l)
     stats = history.log_stats(loss=lval)
@@ -147,6 +188,12 @@ def main(argv=None):
                         choices=["float32", "bfloat16"])
     parser.add_argument("--export_dir", default=None)
     parser.add_argument("--log_steps", type=int, default=10)
+    parser.add_argument("--data_dir", default=None,
+                        help="dir of raw text files: byte-level LM via "
+                             "data.byte_lm_reader (synthetic when omitted)")
+    parser.add_argument("--shuffle_buffer", type=int, default=2048)
+    parser.add_argument("--epochs", type=int, default=1,
+                        help="file passes in --data_dir mode")
     args, _ = parser.parse_known_args(argv)
 
     b = backend.LocalBackend(args.cluster_size)

@@ -87,6 +87,48 @@ def tfrecord_rows(path, binary_features=(), schema=None):
         yield dfutil.from_example(rec, inferred)
 
 
+def packed_lm_reader(seq_len, tokens_key="tokens", eos_id=None):
+    """FileFeed row reader factory for LM training from TFRecord shards:
+    concatenates each record's int64 ``tokens_key`` feature (appending
+    ``eos_id`` between documents when given) and packs the stream into
+    fixed ``seq_len`` rows — ``{"tokens": int32 (seq_len,)}``.  The tail
+    that can't fill a row is dropped (standard packing)."""
+    def reader(path):
+        from tensorflowonspark_tpu import example_proto, tfrecord
+
+        buf = []
+        for rec in tfrecord.tfrecord_iterator(path):
+            _, toks = example_proto.decode_example(rec)[tokens_key]
+            buf.extend(int(t) for t in toks)
+            if eos_id is not None:
+                buf.append(eos_id)
+            while len(buf) >= seq_len:
+                yield {"tokens": np.asarray(buf[:seq_len], np.int32)}
+                del buf[:seq_len]
+
+    return reader
+
+
+def byte_lm_reader(seq_len, chunk_bytes=1 << 16):
+    """FileFeed row reader factory for byte-level LM training straight from
+    raw text/binary files (vocab 256, zero tokenizer dependencies): the
+    file's byte stream packs into fixed ``seq_len`` rows."""
+    def reader(path):
+        buf = bytearray()
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(chunk_bytes)
+                if not chunk:
+                    break
+                buf.extend(chunk)
+                while len(buf) >= seq_len:
+                    yield {"tokens": np.frombuffer(
+                        bytes(buf[:seq_len]), np.uint8).astype(np.int32)}
+                    del buf[:seq_len]
+
+    return reader
+
+
 class FileFeed(object):
     """Streaming columnar batches from record files (FILES mode).
 

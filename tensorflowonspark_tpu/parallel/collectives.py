@@ -18,17 +18,22 @@ riding ICI is what keeps the step itself device-bound.
 def _host_allreduce(value, reduce):
     """Allgather one scalar per process and reduce host-side (the shared
     core of every helper here); single-process short-circuits to the
-    value itself."""
+    value itself.
+
+    Per-host values travel as float32 (x64 is typically disabled), so a
+    host-LOCAL value is exact only below 2^24; the reduction itself runs
+    in float64 so combining many hosts adds no further error."""
     import jax
 
     if jax.process_count() == 1:
         return float(value)
+    import numpy as np
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
     vals = multihost_utils.process_allgather(
         jnp.asarray(float(value), jnp.float32))
-    return float(reduce(vals))
+    return float(reduce(np.asarray(vals, np.float64)))
 
 
 def all_hosts_agree(local_flag, mesh=None):

@@ -62,10 +62,15 @@ class ShardedFeed(object):
       pad_final: when the feed ends mid-batch, pad the final global batch to
         full size and attach a validity mask instead of dropping the tail.
       prefetch: number of batches to assemble ahead on a host thread.
+      sharding: optional NamedSharding overriding the default batch
+        sharding for data leaves — e.g. ``PartitionSpec(("data",), "seq")``
+        to shard LM token batches over the sequence axis too.  The spec is
+        truncated to each leaf's rank (labels ``(B,)`` take just the batch
+        axes) and the mask always uses the batch-dim entry alone.
     """
 
     def __init__(self, feed, mesh, global_batch_size, preprocess=None,
-                 transform=None, pad_final=True, prefetch=2):
+                 transform=None, pad_final=True, prefetch=2, sharding=None):
         import jax
 
         assert preprocess is None or transform is None, \
@@ -78,10 +83,26 @@ class ShardedFeed(object):
         self.transform = transform
         self.pad_final = pad_final
         self._prefetch_depth = prefetch
-        self._sharding = mesh_mod.batch_sharding(mesh)
+        self._sharding = sharding or mesh_mod.batch_sharding(mesh)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        self._mask_sharding = NamedSharding(
+            mesh, PartitionSpec(*tuple(self._sharding.spec)[:1]))
+        self._leaf_shardings = {}    # ndim -> NamedSharding (hot-path cache)
         self._num_processes = jax.process_count()
         self._stop = None            # prefetch stop event (set in batches())
         self._prefetch_thread = None
+
+    def _leaf_sharding(self, ndim):
+        """Data-leaf sharding with the spec truncated to the leaf's rank
+        (cached per rank — this sits on the per-step transfer path)."""
+        if ndim not in self._leaf_shardings:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            spec = tuple(self._sharding.spec)[:ndim]
+            self._leaf_shardings[ndim] = NamedSharding(
+                self.mesh, PartitionSpec(*spec))
+        return self._leaf_shardings[ndim]
 
     # -- host-side batch assembly ----------------------------------------
 
@@ -128,10 +149,12 @@ class ShardedFeed(object):
         mask[:count] = 1.0
 
         def put(x):
-            return jax.make_array_from_process_local_data(self._sharding, x)
+            return jax.make_array_from_process_local_data(
+                self._leaf_sharding(np.ndim(x)), x)
 
         batch = jax.tree_util.tree_map(put, local)
-        return batch, put(mask)
+        return batch, jax.make_array_from_process_local_data(
+            self._mask_sharding, mask)
 
     # -- public iteration -------------------------------------------------
 
@@ -154,6 +177,11 @@ class ShardedFeed(object):
         if drain not in ("any", "all"):
             raise ValueError(
                 "drain must be 'any' or 'all', got {!r}".format(drain))
+        if drain == "all" and not self.pad_final:
+            # pad_final=False drops partial tails before the drain logic
+            # ever sees them — silently violating exact-eval semantics.
+            raise ValueError(
+                "drain='all' (exact evaluation) requires pad_final=True")
         stop = self._stop = threading.Event()
         source = (self._prefetched(stop, self._sharded_iter())
                   if self._prefetch_depth else self._sharded_iter())
@@ -312,13 +340,23 @@ class ShardedFeed(object):
         stays in single mode — partial batches only occur at the end of the
         feed, and a deterministic mode switch keeps hosts alignable."""
         import jax
+        from jax.sharding import NamedSharding, PartitionSpec
 
-        scan_sharding = mesh_mod.scan_batch_sharding(self.mesh)
+        scan_cache = {}
+
+        def scan_sharding(ndim_stacked):
+            # leading scan dim unsharded; the rest follows the (possibly
+            # overridden) batch sharding truncated to the leaf's rank
+            if ndim_stacked not in scan_cache:
+                spec = (None,) + tuple(self._sharding.spec)[:ndim_stacked - 1]
+                scan_cache[ndim_stacked] = NamedSharding(
+                    self.mesh, PartitionSpec(*spec))
+            return scan_cache[ndim_stacked]
 
         def put_stack(cols):
             stacked = np.stack([np.asarray(c) for c in cols])
             return jax.make_array_from_process_local_data(
-                scan_sharding, stacked)
+                scan_sharding(stacked.ndim), stacked)
 
         # Loop invariant: every group's rows are all real, so the (k, B) mask
         # stack is built and transferred once and reused for every group

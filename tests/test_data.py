@@ -121,3 +121,67 @@ class TestFileFeed:
         feed = data_mod.FileFeed(["x"], row_reader=bad_reader, shard=False)
         with pytest.raises(RuntimeError, match="corrupt shard"):
             _drain(feed)
+
+
+class TestLMReaders:
+    def test_byte_lm_reader_packs_and_covers(self, tmp_path):
+        p = tmp_path / "doc.txt"
+        payload = bytes(range(256)) * 5  # 1280 bytes
+        p.write_bytes(payload)
+        feed = data_mod.FileFeed([str(p)],
+                                 row_reader=data_mod.byte_lm_reader(100),
+                                 shard=False)
+        rows = []
+        while not feed.should_stop():
+            arrays, count = feed.next_batch_arrays(4)
+            if count == 0:
+                break
+            rows.extend(np.asarray(arrays["tokens"])[:count])
+        assert len(rows) == 12  # 1280 // 100, tail dropped
+        got = b"".join(bytes(r.astype(np.uint8)) for r in rows)
+        assert got == payload[:1200]  # exact byte stream, in order
+
+    def test_packed_lm_reader_concatenates_documents(self, tmp_path):
+        from tensorflowonspark_tpu import example_proto, tfrecord
+
+        path = str(tmp_path / "toks.tfrecord")
+        with tfrecord.TFRecordWriter(path) as w:
+            for doc in ([1, 2, 3], [4, 5], [6, 7, 8, 9]):
+                w.write(example_proto.encode_example(
+                    {"tokens": ("int64", doc)}))
+        feed = data_mod.FileFeed(
+            [path], row_reader=data_mod.packed_lm_reader(4, eos_id=0),
+            shard=False)
+        rows = []
+        while not feed.should_stop():
+            arrays, count = feed.next_batch_arrays(8)
+            if count == 0:
+                break
+            rows.extend(np.asarray(arrays["tokens"])[:count])
+        # stream: 1 2 3 0 4 5 0 6 7 8 9 0 -> rows of 4
+        assert [r.tolist() for r in rows] == [
+            [1, 2, 3, 0], [4, 5, 0, 6], [7, 8, 9, 0]]
+
+
+def test_sharded_feed_sharding_override(shards):
+    """A PartitionSpec(("data",), "seq") override shards 2-d leaves over
+    both axes, truncates for 1-d leaves, and keeps the mask batch-only."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    mesh = mesh_mod.build_mesh(mesh_mod.MeshSpec(data=4, seq=2),
+                               keep_trivial_axes=True)
+    feed = data_mod.FileFeed(data_mod.list_shards(shards), shard=False)
+    override = NamedSharding(mesh, PartitionSpec(("data",), "seq"))
+    sf = ShardedFeed(
+        feed, mesh, global_batch_size=8, sharding=override, prefetch=0,
+        transform=lambda a: {
+            "tok": np.tile(np.asarray(a["id"], np.int32)[:, None], (1, 16)),
+            "label": np.asarray(a["id"], np.int32)})
+    batch, mask = next(sf.batches())
+    assert batch["tok"].sharding.spec == PartitionSpec(("data",), "seq")
+    assert batch["label"].sharding.spec == PartitionSpec(("data",))
+    assert mask.sharding.spec == PartitionSpec(("data",))
+    assert batch["tok"].shape == (8, 16)

@@ -172,3 +172,17 @@ def test_resnet_imagenet_tfrecord_streaming(tmp_path):
                       timeout=420)  # 3 programs compile (multi/single/eval)
     assert "train stats" in out
     assert "eval accuracy:" in out
+
+
+@pytest.mark.slow
+def test_transformer_byte_lm_from_text(tmp_path):
+    """Byte-level LM from raw text files through the sequence-sharded
+    feed plane (dp x sp x tp mesh)."""
+    for i in range(2):
+        (tmp_path / ("doc%d.txt" % i)).write_text("tpu mesh ring " * 500)
+    out = run_example("transformer/transformer_lm.py",
+                      ["--cluster_size", "1", "--data", "2", "--seq", "2",
+                       "--tensor", "2", "--seq_len", "128",
+                       "--train_steps", "3", "--vocab_size", "512",
+                       "--data_dir", str(tmp_path)])
+    assert "train stats" in out

@@ -34,13 +34,25 @@ def main_fun(args, ctx):
     ctx.initialize_distributed()
     mesh = mesh_mod.build_mesh()
 
+    # Chief-only TensorBoard curves (loss / throughput / MFU per metrics
+    # window) — lands in the same log_dir the framework-launched
+    # TensorBoard watches; no TF dependency (summary.SummaryWriter).
+    writer = None
+    if getattr(args, "log_dir", None) and ctx.is_chief():
+        from tensorflowonspark_tpu import summary
+
+        # local path (SummaryWriter strips file:// and rejects remote
+        # schemes — point TensorBoard at the same local log_dir)
+        writer = summary.SummaryWriter(args.log_dir)
+
     model = mnist_mod.build_mnist(dtype="bfloat16")
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 28, 28, 1)))["params"]
     trainer = train_mod.Trainer(
         mnist_mod.loss_fn(model), params,
         optax.sgd(args.lr, momentum=0.9), mesh=mesh,
-        compute_dtype=jnp.bfloat16, batch_size=args.batch_size)
+        compute_dtype=jnp.bfloat16, batch_size=args.batch_size,
+        summary_writer=writer)
 
     def preprocess(items):
         # CSV rows arrive as (label, 784 pixels); TFRecord rows as dicts.
@@ -61,8 +73,13 @@ def main_fun(args, ctx):
     # dispatch; tail batches fall back to single steps automatically).
     # getattr: callers that reuse this fn with their own parser (e.g.
     # mnist_streaming) may not define the flag.
-    stats = trainer.fit_feed(sharded, max_steps=args.max_steps,
-                             steps_per_call=getattr(args, "steps_per_call", 1))
+    try:
+        stats = trainer.fit_feed(
+            sharded, max_steps=args.max_steps,
+            steps_per_call=getattr(args, "steps_per_call", 1))
+    finally:
+        if writer is not None:
+            writer.close()  # keep buffered curves even when training fails
 
     if args.export_dir and checkpoint.should_export(ctx):
         checkpoint.export_model(
@@ -103,13 +120,16 @@ def main(argv=None):
                              "in-memory data when omitted")
     parser.add_argument("--export_dir", default="mnist_export")
     parser.add_argument("--tensorboard", action="store_true")
+    parser.add_argument("--log_dir", default=None,
+                        help="TensorBoard event dir: chief writes loss/"
+                             "throughput/MFU curves (summary.SummaryWriter)")
     args, _ = parser.parse_known_args(argv)
 
     b = backend.LocalBackend(args.cluster_size)
     try:
         c = cluster.run(b, main_fun, args, num_executors=args.cluster_size,
                         input_mode=cluster.InputMode.SPARK,
-                        tensorboard=args.tensorboard)
+                        tensorboard=args.tensorboard, log_dir=args.log_dir)
         if args.data_dir:
             parts = list(csv_partitions(args.data_dir))
         else:

@@ -77,13 +77,17 @@ class TimeHistory(object):
     """
 
     def __init__(self, batch_size, log_steps=20, step_flops=None,
-                 num_devices=None):
+                 num_devices=None, summary_writer=None):
         import jax
 
         self.batch_size = batch_size
         self.log_steps = log_steps
         self.step_flops = step_flops  # per-device FLOPs (post-partitioning)
         self.num_devices = num_devices or len(jax.devices())
+        # optional tensorflowonspark_tpu.summary.SummaryWriter: window
+        # scalars (loss/throughput/MFU) land in TensorBoard (chief-only by
+        # caller convention)
+        self.summary_writer = summary_writer
         self.global_steps = 0
         self.timestamp_log = []
         self.train_start_time = None
@@ -133,6 +137,20 @@ class TimeHistory(object):
             if mfu is not None:
                 msg += ", %.1f%% MFU" % (100 * mfu)
             logger.info(msg)
+            if self.summary_writer is not None:
+                scalars = {"examples_per_sec": eps,
+                           "ms_per_step": 1000 * elapsed / window_steps}
+                if mfu is not None:
+                    scalars["mfu"] = mfu
+                if value is not None:
+                    try:
+                        scalars["loss"] = float(value)
+                    except TypeError:
+                        pass  # non-scalar sync value: skip the loss curve
+                self.summary_writer.add_scalars(scalars, self.global_steps)
+                # flush per window (amortized by log_steps): live dashboards
+                # update mid-run and a killed job keeps its curves
+                self.summary_writer.flush()
             self.timestamp_log.append((self.global_steps, now))
             self.start_time = now
 
@@ -189,4 +207,10 @@ class TimeHistory(object):
     def log_stats(self, **kwargs):
         stats = self.build_stats(**kwargs)
         logger.info("train stats: %s", json.dumps(stats, default=float))
+        if self.summary_writer is not None:
+            final = {k: float(stats[k]) for k in
+                     ("loss", "avg_exp_per_second", "avg_step_seconds",
+                      "mfu", "eval_loss", "accuracy_top_1") if k in stats}
+            self.summary_writer.add_scalars(final, self.global_steps)
+            self.summary_writer.flush()
         return stats

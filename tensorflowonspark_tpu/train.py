@@ -81,7 +81,8 @@ class Trainer(object):
 
     def __init__(self, loss_fn, init_params, optimizer, mesh=None,
                  extra_state=None, compute_dtype=None, batch_size=None,
-                 log_steps=20, donate=True, accum_steps=1):
+                 log_steps=20, donate=True, accum_steps=1,
+                 summary_writer=None):
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh()
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -89,6 +90,9 @@ class Trainer(object):
         self.batch_size = batch_size
         self.log_steps = log_steps
         self.accum_steps = accum_steps
+        # optional summary.SummaryWriter: window scalars -> TensorBoard
+        # (create it on the chief only; see checkpoint.should_export)
+        self.summary_writer = summary_writer
         self._has_extra = extra_state is not None
 
         replicated = mesh_mod.replicated(self.mesh)
@@ -264,7 +268,7 @@ class Trainer(object):
                 example_batch, example_mask)
             self.history = metrics_mod.TimeHistory(
                 batch_size=self.batch_size or 0, log_steps=self.log_steps,
-                step_flops=flops)
+                step_flops=flops, summary_writer=self.summary_writer)
             self.history.on_train_begin()
 
     def repeat_step(self, batch, mask, k):
@@ -300,7 +304,8 @@ class Trainer(object):
         if self.history is not None:
             self.history = metrics_mod.TimeHistory(
                 batch_size=self.batch_size or 0, log_steps=self.log_steps,
-                step_flops=self.history.step_flops)
+                step_flops=self.history.step_flops,
+                summary_writer=self.summary_writer)
             self.history.on_train_begin()
 
     def step(self, batch, mask=None):

@@ -255,24 +255,17 @@ def _evaluate(args, ctx, mesh, model, trainer, size, in_dtype):
             "image": np.asarray(cols["image"]),
             "label": np.asarray(cols["label"], np.int32)})
 
-    @jax.jit
-    def eval_step(params, batch_stats, batch, mask):
+    def metric_fn(params, batch_stats, batch, mask):
         logits = model.apply(
             {"params": params, "batch_stats": batch_stats},
             imagenet_input.normalize_on_device(batch["image"], in_dtype),
             train=False)
         correct = ((logits.argmax(-1) == batch["label"]) * mask).sum()
-        return correct, mask.sum()
+        return {"accuracy": correct}, mask.sum()
 
-    correct = total = 0.0
-    # drain="all": exhausted hosts step with zero-mask dummies until every
-    # host finishes, so no validation row is dropped (exact eval).
-    for batch, mask in sharded.batches(drain="all"):
-        c, t = eval_step(trainer.state.params, trainer.state.extra,
-                         batch, mask)
-        correct += float(c)
-        total += float(t)
-    return correct / max(total, 1.0)
+    # Trainer.evaluate: drain="all" exact evaluation (exhausted hosts step
+    # zero-mask dummies, no validation row dropped), jitted per batch.
+    return trainer.evaluate(sharded, metric_fn)["accuracy"]
 
 
 def _finish(args, ctx, trainer, ckpt, step, size):

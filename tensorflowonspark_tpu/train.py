@@ -201,6 +201,7 @@ class Trainer(object):
         self._train_step = jax.jit(self._step_core,
                                    donate_argnums=self._donate)
         self._multi_cache = {}  # k -> jitted k-step scan program
+        self._eval_cache = {}   # metric_fn -> jitted wrapper (evaluate)
         self.history = None
 
     def _get_multi_step(self, k):
@@ -291,6 +292,43 @@ class Trainer(object):
         self.state, loss = fn(self.state, batches, masks)
         self.history.on_steps_end(k, loss)
         return loss
+
+    def evaluate(self, sharded_feed, metric_fn):
+        """Exact evaluation over a feed: iterates
+        ``sharded_feed.batches(drain="all")`` (every host's rows count —
+        exhausted hosts step zero-mask dummies) and accumulates
+        mask-weighted metric sums.
+
+        ``metric_fn(params[, extra], batch, mask) -> (sums, weight)`` runs
+        jitted per batch: ``sums`` is a dict of mask-weighted SUMS over the
+        global batch, ``weight`` the batch's real-row count (``mask.sum()``
+        for per-row metrics).  Returns ``{name: total_sum / total_weight}``
+        — e.g. top-1 accuracy from
+        ``{"accuracy": ((pred == label) * mask).sum()}, mask.sum()``.
+
+        Jitted sums over globally-sharded batches are already all-host
+        totals (replicated), so host-side accumulation needs no extra
+        collective."""
+        if metric_fn not in self._eval_cache:
+            # one jit wrapper per metric fn: repeat evaluations (periodic
+            # validation) reuse the compiled executable instead of retracing
+            self._eval_cache[metric_fn] = jax.jit(metric_fn)
+        fn = self._eval_cache[metric_fn]
+        if self._has_extra:
+            call = lambda b, m: fn(self.state.params, self.state.extra, b, m)
+        else:
+            call = lambda b, m: fn(self.state.params, b, m)
+        totals = None
+        weight_total = 0.0
+        for batch, mask in sharded_feed.batches(drain="all"):
+            sums, weight = call(batch, mask)
+            sums = {k: float(v) for k, v in sums.items()}
+            totals = (sums if totals is None else
+                      {k: totals[k] + sums[k] for k in totals})
+            weight_total += float(weight)
+        if totals is None:
+            return {}
+        return {k: v / max(weight_total, 1.0) for k, v in totals.items()}
 
     def compile_and_measure(self, example_batch, example_mask):
         """Lower/compile once and capture per-step FLOPs for MFU reporting."""

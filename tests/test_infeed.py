@@ -245,3 +245,35 @@ def test_fit_feed_on_steps_hook(mgr):
     seen = []
     tr.fit_feed(sf, steps_per_call=2, on_steps=seen.append)
     assert seen == [2, 4]  # one call per 2-step group dispatch
+
+
+def test_trainer_evaluate_exact(mgr):
+    """Trainer.evaluate: mask-weighted metric means over a drain='all'
+    feed, padded tail included exactly."""
+    rows = [([float(i), 0.0], float(i)) for i in range(20)]  # y = x[0]
+    _fill(mgr, rows)
+    feed = DataFeed(mgr, input_mapping={"a_x": "x", "b_y": "y"})
+    mesh = build_mesh()
+    sf = ShardedFeed(feed, mesh, global_batch_size=8, prefetch=0)
+
+    from tensorflowonspark_tpu.train import Trainer
+    import jax.numpy as jnp
+
+    def loss(params, batch, mask):
+        pred = jnp.asarray(batch["x"]) @ params["w"]
+        err = (pred - jnp.asarray(batch["y"])) ** 2 * mask
+        return err.sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+    tr = Trainer(loss, {"w": jnp.asarray([1.0, 0.0])}, optax.sgd(0.1),
+                 mesh=mesh, batch_size=8)
+
+    def metric_fn(params, batch, mask):
+        pred = jnp.asarray(batch["x"]) @ params["w"]
+        err2 = ((pred - jnp.asarray(batch["y"])) ** 2 * mask).sum()
+        return {"mse": err2, "pred_sum": (pred * mask).sum()}, mask.sum()
+
+    out = tr.evaluate(sf, metric_fn)
+    # w = [1, 0] predicts y exactly: mse 0; mean prediction = mean(0..19)
+    assert out["mse"] == 0.0
+    np.testing.assert_allclose(out["pred_sum"], np.mean(range(20)),
+                               rtol=1e-6)

@@ -112,11 +112,18 @@ def main_fun(args, ctx):
     else:
         loss = base_loss
 
+    writer = None
+    if args.log_dir and ctx.is_chief():
+        from tensorflowonspark_tpu import summary
+
+        writer = summary.SummaryWriter(args.log_dir)
+
     trainer = train_mod.Trainer(
         loss,
         params, optimizer, mesh=mesh, extra_state=batch_stats,
         compute_dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
-        batch_size=args.batch_size, log_steps=args.log_steps)
+        batch_size=args.batch_size, log_steps=args.log_steps,
+        summary_writer=writer)
 
     ckpt = None
     if args.model_dir:
@@ -225,6 +232,9 @@ def _maybe_eval(args, ctx, mesh, model, trainer, size, in_dtype, stats):
         acc = _evaluate(args, ctx, mesh, model, trainer, size, in_dtype)
         stats["eval_accuracy_top_1"] = acc
         print("eval accuracy: {:.4f}".format(acc))
+        if trainer.summary_writer is not None:
+            trainer.summary_writer.add_scalar(
+                "eval_accuracy_top_1", acc, int(trainer.state.step))
 
 
 def _evaluate(args, ctx, mesh, model, trainer, size, in_dtype):
@@ -275,6 +285,8 @@ def _finish(args, ctx, trainer, ckpt, step, size):
 
     from tensorflowonspark_tpu import checkpoint
 
+    if trainer.summary_writer is not None:
+        trainer.summary_writer.close()
     if ckpt:
         ckpt.maybe_save(step, trainer.state, force=True)
         ckpt.wait_until_finished()
@@ -331,6 +343,9 @@ def main(argv=None):
     parser.add_argument("--export_dir", default=None)
     parser.add_argument("--save_interval", type=int, default=1000)
     parser.add_argument("--log_steps", type=int, default=20)
+    parser.add_argument("--log_dir", default=None,
+                        help="TensorBoard event dir (chief writes loss/"
+                             "throughput/MFU curves + eval accuracy)")
     parser.add_argument("--profile_steps", default=None)
     parser.add_argument("--profile_dir", default=None)
     args, rem = parser.parse_known_args(argv)

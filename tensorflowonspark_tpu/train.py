@@ -308,10 +308,17 @@ class Trainer(object):
 
         Jitted sums over globally-sharded batches are already all-host
         totals (replicated), so host-side accumulation needs no extra
-        collective."""
+        collective.
+
+        The jit wrapper is cached on the metric fn's identity: for periodic
+        validation, pass the SAME function object every call (define it
+        once, not as a fresh closure per evaluation) or each call retraces
+        and the cache grows."""
         if metric_fn not in self._eval_cache:
-            # one jit wrapper per metric fn: repeat evaluations (periodic
-            # validation) reuse the compiled executable instead of retracing
+            if len(self._eval_cache) >= 8:
+                # runaway guard: fresh-closure callers would otherwise pin
+                # one compiled executable per evaluation forever
+                self._eval_cache.clear()
             self._eval_cache[metric_fn] = jax.jit(metric_fn)
         fn = self._eval_cache[metric_fn]
         if self._has_extra:

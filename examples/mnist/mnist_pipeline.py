@@ -47,7 +47,8 @@ def train_fn(args, ctx):
         input_mapping={"image": "image", "label": "label"})
     sharded = infeed.ShardedFeed(feed, mesh, args.batch_size,
                                  preprocess=preprocess)
-    trainer.fit_feed(sharded)
+    trainer.fit_feed(
+        sharded, steps_per_call=getattr(args, "steps_per_call", 1))
 
     if checkpoint.should_export(ctx):
         checkpoint.export_model(

@@ -93,6 +93,10 @@ PARAMS = {
     "num_ps": (0, "number of ps-like (long-running non-worker) nodes"),
     "grace_secs": (30, "grace period after feeding ends (chief export time)"),
     "steps": (1000, "max number of steps to train"),
+    "steps_per_call": (1, "train steps per device dispatch (lax.scan "
+                          "groups; amortizes dispatch latency)"),
+    "accum_steps": (1, "gradient-accumulation microbatches per step"),
+    "chunk_size": (1024, "rows per columnar feed chunk"),
     "tensorboard": (False, "launch tensorboard on the chief"),
     "feed_timeout": (600, "timeout (secs) for feeding a partition"),
 }
@@ -223,7 +227,8 @@ class TFEstimator(TFParams, _MLEstimator):
             master_node=local_args.master_node,
         )
         tpu_cluster.train(rows, num_epochs=local_args.epochs,
-                          feed_timeout=local_args.feed_timeout)
+                          feed_timeout=local_args.feed_timeout,
+                          chunk_size=local_args.chunk_size)
         tpu_cluster.shutdown(grace_secs=local_args.grace_secs)
         return TFModel(local_args, backend=self.backend)
 

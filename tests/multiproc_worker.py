@@ -9,6 +9,11 @@ exercising a ``jax.process_count() > 1`` code path:
 - ``consensus``:   uneven end-of-data across hosts -> all stop together
 - ``infeed``:      ShardedFeed assembles a global batch from per-process
                    local shards, including an uneven padded tail
+- ``grouped``:     K-step group consensus degrades all hosts to single
+                   mode in lock-step on uneven feeds
+- ``drain``:       batches(drain='all') exact-eval dummies keep hosts
+                   aligned until everyone is exhausted
+- ``filefeed``:    FILES-mode FileFeed file sharding across processes
 - ``checkpoint``:  orbax collective save/restore with every host entering
                    the save (non-chief included)
 
@@ -170,6 +175,52 @@ def scenario_drain_all(rank, world, tmpdir):
     print("drain ok", rank, mask_sums)
 
 
+def scenario_filefeed(rank, world, tmpdir):
+    """FILES mode multi-host: data.FileFeed shards files by process and the
+    ShardedFeed consensus keeps hosts aligned — every row lands exactly
+    once across the world."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import data as data_mod, dfutil
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel.infeed import ShardedFeed
+
+    shard_dir = os.path.join(tmpdir, "shards")
+    marker = os.path.join(tmpdir, "staged")
+    if rank == 0:
+        rows = dfutil.Rows([{"v": float(i)} for i in range(40)],
+                           schema={"v": "float32"})
+        dfutil.save_as_tfrecords(rows, shard_dir, num_shards=4)
+        open(marker, "w").close()
+    else:
+        deadline = time.time() + 60
+        while not os.path.exists(marker):
+            assert time.time() < deadline, "staging never appeared"
+            time.sleep(0.1)
+
+    import numpy as np
+
+    mesh = mesh_mod.build_mesh()
+    feed = data_mod.FileFeed(data_mod.list_shards(shard_dir))  # shard=True
+    sf = ShardedFeed(
+        feed, mesh, global_batch_size=8 * world, prefetch=2,
+        transform=lambda cols: np.asarray(cols["v"], np.float32))
+
+    sums = []
+    mask_sums = []
+    for batch, mask in sf.batches():
+        sums.append(float(jax.jit(lambda b, m: (b * m).sum())(batch, mask)))
+        mask_sums.append(float(jax.jit(jnp.sum)(mask)))
+    # 40 rows over the world: world=2 -> 20/host -> [full, full, padded 4]
+    assert mask_sums == [8.0 * world, 8.0 * world, 4.0 * world], (
+        rank, mask_sums)
+    assert abs(sum(sums) - sum(range(40))) < 1e-3, (rank, sums)
+    print("filefeed ok", rank, mask_sums)
+
+
 def scenario_checkpoint(rank, world, tmpdir):
     import jax
     import jax.numpy as jnp
@@ -201,6 +252,7 @@ SCENARIOS = {
     "infeed": scenario_infeed,
     "grouped": scenario_grouped,
     "drain": scenario_drain_all,
+    "filefeed": scenario_filefeed,
     "checkpoint": scenario_checkpoint,
 }
 

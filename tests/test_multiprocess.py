@@ -78,3 +78,7 @@ class TestMultiProcess:
     def test_drain_all_consumes_every_row(self, tmp_path):
         outs = _run_world("drain", tmp_path)
         assert all("drain ok" in o for o in outs)
+
+    def test_filefeed_multihost_file_sharding(self, tmp_path):
+        outs = _run_world("filefeed", tmp_path)
+        assert all("filefeed ok" in o for o in outs)

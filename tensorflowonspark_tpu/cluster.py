@@ -92,22 +92,49 @@ class TPUCluster(object):
                 # awaitTermination loop (shutdown(ssc=...)) stops the
                 # context; don't feed them into terminating nodes.
                 if not cluster.server.done:
-                    rdd.foreachPartition(fn)
+                    try:
+                        rdd.foreachPartition(fn)
+                    except Exception as e:
+                        # scheduler-thread failure never reaches the driver
+                        # thread: latch it so shutdown(ssc=...) exits 1
+                        cluster._latch_error(e)
+                        raise
 
             data.foreachRDD(_feed_batch)
         elif hasattr(data, "__next__"):  # streaming source: unbounded partitions
             # Streaming has no epochs: feed each partition once.
             fn = node.train(self.cluster_info, self.cluster_meta, qname,
                             feed_timeout, chunk_size)
-            for part in data:
-                if self.server.done:
-                    logger.info("STOP requested; ending streaming feed")
-                    break
-                self.backend.foreach_partition([part], fn)
+            try:
+                for part in data:
+                    if self.server.done:
+                        logger.info("STOP requested; ending streaming feed")
+                        break
+                    self.backend.foreach_partition([part], fn)
+            except Exception as e:
+                self._latch_error(e)
+                raise
         elif hasattr(data, "foreachPartition"):  # Spark RDD
-            self.backend.foreach_partition(data, fn)
+            self._feed_or_latch(data, fn)
         else:
-            self.backend.foreach_partition(list(data), fn)
+            self._feed_or_latch(list(data), fn)
+
+    def _feed_or_latch(self, partitions, fn):
+        """Dispatch a feed job; a failure (user-code error OR a consumer
+        that died without one — e.g. OOM-killed, surfaced as the feeder's
+        feed_timeout) is latched into ``tf_status`` so a later
+        ``shutdown()`` still exits non-zero (reference ``tf_status``
+        error propagation, ``TFCluster.py:177-181``)."""
+        try:
+            self.backend.foreach_partition(partitions, fn)
+        except Exception as e:
+            self._latch_error(e)
+            raise
+
+    def _latch_error(self, exc):
+        if "error" not in self.tf_status:
+            self.tf_status["error"] = "{}: {}".format(
+                type(exc).__name__, exc)
 
     def inference(self, data, qname="input", chunk_size=1024):
         """Feed data for inference, returning per-item results (reference
@@ -118,10 +145,14 @@ class TPUCluster(object):
             "inference() feeding requires InputMode.SPARK"
         fn = node.inference(self.cluster_info, self.cluster_meta, qname,
                             chunk_size=chunk_size)
-        results = self.backend.map_partitions(data, fn)
-        if hasattr(results, "collect"):  # Spark path returns an RDD-like
-            return results
-        return [item for part in results if part for item in part]
+        try:
+            results = self.backend.map_partitions(data, fn)
+            if hasattr(results, "collect"):  # Spark path returns an RDD-like
+                return results
+            return [item for part in results if part for item in part]
+        except Exception as e:
+            self._latch_error(e)
+            raise
 
     # -- lifecycle --------------------------------------------------------
 
@@ -201,7 +232,7 @@ class TPUCluster(object):
                     if part:
                         covered.add(part[0])
             except (RuntimeError, TimeoutError) as e:
-                self.tf_status["error"] = str(e)
+                self._latch_error(e)  # first error wins: keep the root cause
                 break
         else:
             if worker_ids - covered and "error" not in self.tf_status:

@@ -354,3 +354,45 @@ def test_columnar_feed_without_shm_ring():
         assert total == sum(range(16)) * 2
     finally:
         b.stop()
+
+
+def test_hard_killed_consumer_surfaces_feed_timeout(local_backend, tmp_path):
+    """SIGKILL the training process mid-run (the OOM-killer scenario): it
+    can't push an error through the queue, so the feeder's feed_timeout
+    watchdog must surface the failure to the driver instead of hanging
+    (reference feed_timeout, TFSparkNode.py:410-418)."""
+    import signal
+    import time as _time
+
+    pid_dir = str(tmp_path / "pids")
+    os.makedirs(pid_dir)
+
+    def map_fun(args, ctx):
+        import os as _os
+        import time as _t
+
+        # write-then-rename: the driver polls listdir and must never read
+        # a created-but-unflushed file
+        tmp = os.path.join(args, ".tmp-%d" % ctx.process_id)
+        with open(tmp, "w") as f:
+            f.write(str(_os.getpid()))
+        _os.rename(tmp, os.path.join(args, "pid-%d" % ctx.process_id))
+        feed = ctx.get_data_feed()
+        feed.next_batch(1)
+        _t.sleep(600)  # hold the queue un-drained until killed
+
+    c = cluster.run(local_backend, map_fun, tf_args=pid_dir,
+                    num_executors=2, input_mode=InputMode.SPARK)
+    deadline = _time.time() + 30
+    while len([n for n in os.listdir(pid_dir) if n.startswith("pid-")]) < 2:
+        assert _time.time() < deadline, "consumers never reported pids"
+        _time.sleep(0.2)
+    for name in os.listdir(pid_dir):
+        if name.startswith("pid-"):
+            with open(os.path.join(pid_dir, name)) as f:
+                os.kill(int(f.read()), signal.SIGKILL)
+
+    with pytest.raises(Exception, match="Timeout"):
+        c.train(backend.partition(range(100), 2), feed_timeout=8)
+    with pytest.raises(SystemExit):
+        c.shutdown(grace_secs=1)

@@ -209,3 +209,25 @@ def test_mnist_spark_writes_tensorboard_curves(tmp_path):
     # 8 steps < one 20-step metrics window: the final-stats dump still
     # lands; longer runs add per-window examples_per_sec/ms_per_step too
     assert "avg_exp_per_second" in tags and "loss" in tags
+
+
+@pytest.mark.slow
+def test_mnist_files_resume_from_checkpoint(tmp_path):
+    """Restart-resume: a second run restores the first run's checkpoint
+    and continues from its step (reference restore-on-restart via Keras
+    load_weights_on_restart; here CheckpointManager.restore_latest)."""
+    ckpt = str(tmp_path / "ckpt")
+    run_example("mnist/mnist_files.py",
+                ["--cluster_size", "2", "--epochs", "1",
+                 "--max_steps", "3", "--save_interval", "1",
+                 "--model_dir", ckpt])
+    steps1 = {int(d) for d in os.listdir(ckpt) if d.isdigit()}
+    assert max(steps1) == 3, steps1
+    run_example("mnist/mnist_files.py",
+                ["--cluster_size", "2", "--epochs", "1",
+                 "--max_steps", "6", "--save_interval", "1",
+                 "--model_dir", ckpt])
+    steps2 = {int(d) for d in os.listdir(ckpt) if d.isdigit()}
+    # run 2 restored step 3 and continued to the absolute target 6
+    assert max(steps2) == 6, steps2
+    assert 4 in steps2 or 5 in steps2, steps2  # intermediate saves resumed

@@ -461,6 +461,12 @@ def main():
         "feed_plane_images_per_sec": None,
         "feed_plane_vs_baseline": None,
         "device_kind": (resnet or mnist or {}).get("device_kind") or kind,
+        # measurement config (self-describing artifact)
+        "resnet50_config": {"batch": RESNET_BATCH, "steps_per_call":
+                            RESNET_STEPS_PER_CALL, "stem": RESNET_STEM},
+        "mnist_config": {"batch": MNIST_BATCH, "steps_per_call":
+                         MNIST_STEPS_PER_CALL, "epochs": MNIST_EPOCHS,
+                         "rows": MNIST_ROWS},
     }
     if feedplane:
         out["feed_plane_images_per_sec"] = round(

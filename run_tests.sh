@@ -10,8 +10,9 @@
 # jax.distributed worlds.
 #
 # Usage:
-#   ./run_tests.sh            # full suite (~12 min on 8 CPU cores)
-#   ./run_tests.sh -m 'not slow'   # fast subset, ~2:45 — every framework
+#   ./run_tests.sh            # full suite (~27 min on 8 CPU cores; 258
+#                             # tests incl. all example-CLI integration runs)
+#   ./run_tests.sh -m 'not slow'   # fast subset, ~5 min — every framework
 #                                  # module; 'slow' marks the example/cluster
 #                                  # integration runs (each boots multi-
 #                                  # process clusters in subprocesses)

@@ -84,7 +84,8 @@ def tfrecord_rows(path, binary_features=(), schema=None):
     for rec in tfrecord.tfrecord_iterator(path):
         if inferred is None:
             inferred = dfutil.infer_schema(rec, binary_features)
-        yield dfutil.from_example(rec, inferred)
+        # as_numpy: float columns stay vectorized ndarrays end to end
+        yield dfutil.from_example(rec, inferred, as_numpy=True)
 
 
 def packed_lm_reader(seq_len, tokens_key="tokens", eos_id=None):

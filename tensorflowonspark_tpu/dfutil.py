@@ -61,11 +61,17 @@ def to_example(row, schema):
     return example_proto.encode_example(features)
 
 
-def from_example(serialized, schema):
+def from_example(serialized, schema, as_numpy=False):
     """Decode serialized Example bytes into a row dict (reference
     ``fromTFExample``, ``dfutil.py:171-212``).  Bytes-vs-string handling is
     driven entirely by the schema's column types (a ``binary_features`` hint
-    only matters at schema-inference time, see :func:`infer_schema`)."""
+    only matters at schema-inference time, see :func:`infer_schema`).
+
+    ``as_numpy=True`` returns ``array<float32>`` columns as numpy arrays
+    (the vectorized fast path for the streaming FILES pipeline); the
+    default keeps plain Python lists for DataFrame compatibility."""
+    import numpy as np
+
     feats = example_proto.decode_example(serialized)
     row = {}
     for name, coltype in schema.items():
@@ -75,13 +81,21 @@ def from_example(serialized, schema):
             values = [v.decode("utf-8") if isinstance(v, bytes) else v
                       for v in values]
         elif base == "float32":
-            values = [float(v) for v in values]
+            values = np.asarray(values, np.float32)
+            if not as_numpy:
+                # plain Python floats: pyspark's ArrayType verifier (and
+                # any list-expecting caller) rejects ndarrays
+                values = values.tolist()
         elif base == "int64":
             values = [int(v) for v in values]
         if coltype.startswith("array<"):
             row[name] = values
         else:
-            row[name] = values[0] if values else None
+            if len(values) == 0:
+                row[name] = None
+            else:
+                v = values[0]
+                row[name] = float(v) if base == "float32" else v
     return row
 
 

@@ -29,6 +29,15 @@ from tensorflowonspark_tpu import dfutil, schema as schema_mod
 logger = logging.getLogger(__name__)
 
 
+def _json_default(o):
+    """Numpy scalars/arrays (vectorized TFRecord decode) serialize as plain
+    JSON numbers/lists; anything else still fails loudly."""
+    if isinstance(o, (np.ndarray, np.generic)):
+        return o.tolist()
+    raise TypeError(
+        "Object of type {} is not JSON serializable".format(type(o).__name__))
+
+
 def run_inference(export_dir, rows, input_mapping=None, output_name=None,
                   output_mapping=None, batch_size=128):
     """Yield one output row dict per input row (1:1 contract, reference
@@ -144,7 +153,7 @@ def main(argv=None):
     try:
         n = 0
         for out in results:
-            out_f.write(json.dumps(out) + "\n")
+            out_f.write(json.dumps(out, default=_json_default) + "\n")
             n += 1
         logger.info("wrote %d predictions", n)
     finally:

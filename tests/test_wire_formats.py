@@ -4,7 +4,10 @@ columnar chunk packing) — randomized inputs catch the framing edge cases
 fixed-fixture tests miss."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests skip where absent
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from tensorflowonspark_tpu import example_proto, marker, tfrecord
 

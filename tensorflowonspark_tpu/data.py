@@ -159,6 +159,7 @@ class FileFeed(object):
         self.shuffle_buffer = shuffle_buffer
         self.num_epochs = num_epochs
         self.reader_threads = max(1, min(reader_threads, len(self.files)))
+        self._seed = seed
         self._rng = np.random.default_rng(seed)
         self._queue = _queue.Queue(maxsize=queue_size)
         self._interrupt = threading.Event()
@@ -175,8 +176,16 @@ class FileFeed(object):
     def _reader(self, worker_idx):
         try:
             block = []
+            my_files = list(self.files[worker_idx::self.reader_threads])
+            rng = (np.random.default_rng((self._seed, worker_idx))
+                   if self.shuffle_buffer else None)
             for epoch in range(self.num_epochs):
-                for path in self.files[worker_idx::self.reader_threads]:
+                if rng is not None:
+                    # file-order reshuffle each epoch (tf.data's
+                    # reshuffle_each_iteration at file granularity; row-level
+                    # mixing is the consumer-side reservoir's job)
+                    rng.shuffle(my_files)
+                for path in my_files:
                     for row in self.row_reader(path):
                         block.append(row)
                         if len(block) >= self.BLOCK:

@@ -74,6 +74,11 @@ def evaluator_fun(args, ctx):
     seen = -1
     deadline = time.time() + args.eval_timeout
     while time.time() < deadline:
+        # cheap step probe first: a full restore on every 1 s idle poll
+        # would re-deserialize the same checkpoint continuously
+        if (mgr.latest_step() or -1) <= seen:
+            time.sleep(1)
+            continue
         state, step = mgr.restore_latest(jax.device_get(trainer.state))
         if step is not None and step > seen:
             seen = step

@@ -151,6 +151,12 @@ InputSpec ParseInput(const std::string& arg) {
 int main(int argc, char** argv) {
   std::string plugin_path, program_path, options_path, out_prefix = "out";
   std::vector<InputSpec> inputs;
+  // --batches N: each --input file carries N concatenated buffers of the
+  // declared shape; the module compiles ONCE and executes N times (the
+  // whole point of a serving runner — compilation is minutes on TPU,
+  // execution is milliseconds).  Outputs: out.<b>.<i>.bin when N > 1,
+  // the original out.<i>.bin when N == 1.
+  size_t batches = 1;
   for (int i = 1; i < argc; ++i) {
     std::string a = argv[i];
     auto next = [&](const char* flag) -> std::string {
@@ -162,6 +168,10 @@ int main(int argc, char** argv) {
     else if (a == "--options") options_path = next("--options");
     else if (a == "--input") inputs.push_back(ParseInput(next("--input")));
     else if (a == "--out") out_prefix = next("--out");
+    else if (a == "--batches") {
+      batches = static_cast<size_t>(std::stoul(next("--batches")));
+      if (batches == 0) Die("--batches must be >= 1");
+    }
     else Die("unknown flag " + a);
   }
   if (plugin_path.empty() || program_path.empty())
@@ -219,34 +229,23 @@ int main(int argc, char** argv) {
   Check(api, api->PJRT_Client_Compile(&comp), "compile");
   PJRT_LoadedExecutable* exec = comp.executable;
 
-  // 4. Stage the input buffers on the device.
+  // 4. Read the input files once; each holds `batches` concatenated
+  // buffers of the declared per-batch shape.
   std::vector<std::string> host_data(inputs.size());
-  std::vector<PJRT_Buffer*> arg_buffers(inputs.size());
+  std::vector<size_t> batch_bytes(inputs.size());
   for (size_t i = 0; i < inputs.size(); ++i) {
     const InputSpec& spec = inputs[i];
     host_data[i] = ReadFile(spec.path);
     size_t want = spec.dtype.bytes;
     for (int64_t d : spec.dims) want *= static_cast<size_t>(d);
-    if (host_data[i].size() != want) {
+    batch_bytes[i] = want;
+    if (host_data[i].size() != want * batches) {
       std::ostringstream ss;
       ss << "input " << i << " (" << spec.path << "): file has "
-         << host_data[i].size() << " bytes, dims need " << want;
+         << host_data[i].size() << " bytes, dims need " << want << " x "
+         << batches << " batches";
       Die(ss.str());
     }
-    PJRT_Client_BufferFromHostBuffer_Args bargs;
-    std::memset(&bargs, 0, sizeof(bargs));
-    bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
-    bargs.client = client;
-    bargs.data = host_data[i].data();
-    bargs.type = spec.dtype.type;
-    bargs.dims = spec.dims.data();
-    bargs.num_dims = spec.dims.size();
-    bargs.host_buffer_semantics =
-        PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
-    bargs.device = device;
-    Check(api, api->PJRT_Client_BufferFromHostBuffer(&bargs), "h2d");
-    Await(api, bargs.done_with_host_buffer, "h2d done");
-    arg_buffers[i] = bargs.buffer;
   }
 
   // 5. Execute (single device).
@@ -262,81 +261,107 @@ int main(int argc, char** argv) {
   Check(api, api->PJRT_Executable_NumOutputs(&nargs), "num outputs");
   size_t num_outputs = nargs.num_outputs;
 
-  std::vector<PJRT_Buffer*> out_row(num_outputs, nullptr);
-  PJRT_Buffer** out_lists[1] = {out_row.data()};
-  PJRT_Buffer* const* arg_lists[1] = {arg_buffers.data()};
-  PJRT_Event* done_events[1] = {nullptr};
-
-  PJRT_ExecuteOptions opts;
-  std::memset(&opts, 0, sizeof(opts));
-  opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
-
-  PJRT_LoadedExecutable_Execute_Args eargs;
-  std::memset(&eargs, 0, sizeof(eargs));
-  eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
-  eargs.executable = exec;
-  eargs.options = &opts;
-  eargs.argument_lists = arg_lists;
-  eargs.num_devices = 1;
-  eargs.num_args = arg_buffers.size();
-  eargs.output_lists = out_lists;
-  eargs.device_complete_events = done_events;
-  Check(api, api->PJRT_LoadedExecutable_Execute(&eargs), "execute");
-  Await(api, done_events[0], "execute done");
-
-  // 6. Copy every output back and write <out>.<i>.bin.
-  for (size_t i = 0; i < num_outputs; ++i) {
-    PJRT_Buffer* buf = out_row[i];
-
-    PJRT_Buffer_ElementType_Args targs;
-    targs.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
-    targs.extension_start = nullptr;
-    targs.buffer = buf;
-    Check(api, api->PJRT_Buffer_ElementType(&targs), "output dtype");
-
-    PJRT_Buffer_Dimensions_Args dims_args;
-    dims_args.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
-    dims_args.extension_start = nullptr;
-    dims_args.buffer = buf;
-    Check(api, api->PJRT_Buffer_Dimensions(&dims_args), "output dims");
-
-    PJRT_Buffer_ToHostBuffer_Args hargs;
-    std::memset(&hargs, 0, sizeof(hargs));
-    hargs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
-    hargs.src = buf;
-    Check(api, api->PJRT_Buffer_ToHostBuffer(&hargs), "d2h size");
-    std::string out(hargs.dst_size, '\0');
-    hargs.dst = out.data();
-    Check(api, api->PJRT_Buffer_ToHostBuffer(&hargs), "d2h");
-    Await(api, hargs.event, "d2h done");
-
-    std::string path = out_prefix + "." + std::to_string(i) + ".bin";
-    std::ofstream f(path, std::ios::binary);
-    f.write(out.data(), static_cast<std::streamsize>(out.size()));
-    if (!f) Die("cannot write " + path);
-
-    std::ostringstream dimstr;
-    for (size_t d = 0; d < dims_args.num_dims; ++d) {
-      if (d) dimstr << ",";
-      dimstr << dims_args.dims[d];
+  for (size_t b = 0; b < batches; ++b) {
+    // stage this batch's slice of every input
+    std::vector<PJRT_Buffer*> arg_buffers(inputs.size());
+    for (size_t i = 0; i < inputs.size(); ++i) {
+      const InputSpec& spec = inputs[i];
+      PJRT_Client_BufferFromHostBuffer_Args bargs;
+      std::memset(&bargs, 0, sizeof(bargs));
+      bargs.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+      bargs.client = client;
+      bargs.data = host_data[i].data() + b * batch_bytes[i];
+      bargs.type = spec.dtype.type;
+      bargs.dims = spec.dims.data();
+      bargs.num_dims = spec.dims.size();
+      bargs.host_buffer_semantics =
+          PJRT_HostBufferSemantics_kImmutableUntilTransferCompletes;
+      bargs.device = device;
+      Check(api, api->PJRT_Client_BufferFromHostBuffer(&bargs), "h2d");
+      Await(api, bargs.done_with_host_buffer, "h2d done");
+      arg_buffers[i] = bargs.buffer;
     }
-    std::printf("output %zu: type=%s dims=%s bytes=%zu file=%s\n", i,
-                TypeName(targs.type), dimstr.str().c_str(), out.size(),
-                path.c_str());
 
-    PJRT_Buffer_Destroy_Args bd;
-    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-    bd.extension_start = nullptr;
-    bd.buffer = buf;
-    Check(api, api->PJRT_Buffer_Destroy(&bd), "output destroy");
-  }
+    std::vector<PJRT_Buffer*> out_row(num_outputs, nullptr);
+    PJRT_Buffer** out_lists[1] = {out_row.data()};
+    PJRT_Buffer* const* arg_lists[1] = {arg_buffers.data()};
+    PJRT_Event* done_events[1] = {nullptr};
 
-  for (PJRT_Buffer* buf : arg_buffers) {
-    PJRT_Buffer_Destroy_Args bd;
-    bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-    bd.extension_start = nullptr;
-    bd.buffer = buf;
-    Check(api, api->PJRT_Buffer_Destroy(&bd), "arg destroy");
+    PJRT_ExecuteOptions opts;
+    std::memset(&opts, 0, sizeof(opts));
+    opts.struct_size = PJRT_ExecuteOptions_STRUCT_SIZE;
+
+    PJRT_LoadedExecutable_Execute_Args eargs;
+    std::memset(&eargs, 0, sizeof(eargs));
+    eargs.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    eargs.executable = exec;
+    eargs.options = &opts;
+    eargs.argument_lists = arg_lists;
+    eargs.num_devices = 1;
+    eargs.num_args = arg_buffers.size();
+    eargs.output_lists = out_lists;
+    eargs.device_complete_events = done_events;
+    Check(api, api->PJRT_LoadedExecutable_Execute(&eargs), "execute");
+    Await(api, done_events[0], "execute done");
+
+    // copy every output back; <out>.<i>.bin (one batch, back-compat) or
+    // <out>.<b>.<i>.bin (batched)
+    for (size_t i = 0; i < num_outputs; ++i) {
+      PJRT_Buffer* buf = out_row[i];
+
+      PJRT_Buffer_ElementType_Args targs;
+      targs.struct_size = PJRT_Buffer_ElementType_Args_STRUCT_SIZE;
+      targs.extension_start = nullptr;
+      targs.buffer = buf;
+      Check(api, api->PJRT_Buffer_ElementType(&targs), "output dtype");
+
+      PJRT_Buffer_Dimensions_Args dims_args;
+      dims_args.struct_size = PJRT_Buffer_Dimensions_Args_STRUCT_SIZE;
+      dims_args.extension_start = nullptr;
+      dims_args.buffer = buf;
+      Check(api, api->PJRT_Buffer_Dimensions(&dims_args), "output dims");
+
+      PJRT_Buffer_ToHostBuffer_Args hargs;
+      std::memset(&hargs, 0, sizeof(hargs));
+      hargs.struct_size = PJRT_Buffer_ToHostBuffer_Args_STRUCT_SIZE;
+      hargs.src = buf;
+      Check(api, api->PJRT_Buffer_ToHostBuffer(&hargs), "d2h size");
+      std::string out(hargs.dst_size, '\0');
+      hargs.dst = out.data();
+      Check(api, api->PJRT_Buffer_ToHostBuffer(&hargs), "d2h");
+      Await(api, hargs.event, "d2h done");
+
+      std::string path = batches == 1
+          ? out_prefix + "." + std::to_string(i) + ".bin"
+          : out_prefix + "." + std::to_string(b) + "." +
+                std::to_string(i) + ".bin";
+      std::ofstream f(path, std::ios::binary);
+      f.write(out.data(), static_cast<std::streamsize>(out.size()));
+      if (!f) Die("cannot write " + path);
+
+      std::ostringstream dimstr;
+      for (size_t d = 0; d < dims_args.num_dims; ++d) {
+        if (d) dimstr << ",";
+        dimstr << dims_args.dims[d];
+      }
+      std::printf("output %zu.%zu: type=%s dims=%s bytes=%zu file=%s\n", b,
+                  i, TypeName(targs.type), dimstr.str().c_str(), out.size(),
+                  path.c_str());
+
+      PJRT_Buffer_Destroy_Args bd;
+      bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      bd.extension_start = nullptr;
+      bd.buffer = buf;
+      Check(api, api->PJRT_Buffer_Destroy(&bd), "output destroy");
+    }
+
+    for (PJRT_Buffer* buf : arg_buffers) {
+      PJRT_Buffer_Destroy_Args bd;
+      bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+      bd.extension_start = nullptr;
+      bd.buffer = buf;
+      Check(api, api->PJRT_Buffer_Destroy(&bd), "arg destroy");
+    }
   }
   PJRT_LoadedExecutable_Destroy_Args ed;
   ed.struct_size = PJRT_LoadedExecutable_Destroy_Args_STRUCT_SIZE;

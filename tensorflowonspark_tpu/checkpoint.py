@@ -73,13 +73,23 @@ class CheckpointManager(object):
         self.save_interval_steps = save_interval_steps
 
     def maybe_save(self, step, state, force=False):
-        """Save if the interval elapsed; returns True if saved.
+        """Save if an interval boundary was CROSSED since the last save;
+        returns True if saved.
+
+        Boundary-crossing (not ``step % interval == 0``): callers that see
+        steps at a stride — ``fit_feed(steps_per_call=K)`` reports once per
+        K-step dispatch, possibly offset by a restored step — would
+        otherwise save never (misaligned residues) or at lcm(K, interval).
 
         Must be called by ALL hosts each step (collective; see class doc) —
-        the interval check below is deterministic so hosts agree."""
-        if not force and (not self.save_interval_steps
-                          or step % self.save_interval_steps != 0):
-            return False  # interval 0 means explicit (force=True) saves only
+        the check below is deterministic so hosts agree."""
+        if not force:
+            if not self.save_interval_steps:
+                return False  # interval 0: explicit (force=True) saves only
+            last = self._mgr.latest_step() or 0
+            if (step // self.save_interval_steps
+                    <= last // self.save_interval_steps):
+                return False
         if step == self._mgr.latest_step():
             return False  # already saved (e.g. final force after interval hit)
         import orbax.checkpoint as ocp
@@ -108,6 +118,14 @@ class CheckpointManager(object):
             step, args=ocp.args.StandardRestore(abstract_state))
         logger.info("restored checkpoint step %d from %s", step, self.directory)
         return state, step
+
+    def latest_step(self, reload=True):
+        """Newest saved step, or None.  ``reload=True`` re-reads the step
+        list from storage (orbax caches it), so polling evaluators can
+        probe for new checkpoints cheaply without a full restore."""
+        if reload:
+            self._mgr.reload()
+        return self._mgr.latest_step()
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()

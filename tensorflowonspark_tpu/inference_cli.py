@@ -85,6 +85,10 @@ def run_inference_native(export_dir, rows, plugin_path, input_mapping=None,
     col_for = {t: c for c, t in (input_mapping or {}).items()}
     out_col = dict(output_mapping or {})
     rows = list(rows)
+    # Build every padded chunk first, then serve them through ONE runner
+    # invocation (--batches): the module compiles once instead of per chunk.
+    chunks = []
+    feeds = []
     for lo in range(0, len(rows), bsz):
         chunk = rows[lo:lo + bsz]
         count = len(chunk)
@@ -98,8 +102,12 @@ def run_inference_native(export_dir, rows, plugin_path, input_mapping=None,
                 pad = [(0, bsz - count)] + [(0, 0)] * (vals.ndim - 1)
                 vals = np.pad(vals, pad)
             feed[tensor] = vals
-        outs = serving.run_embedded_native(export_dir, feed, plugin_path)
-        for i in range(count):
+        chunks.append(chunk)
+        feeds.append(feed)
+    all_outs = serving.run_embedded_native_many(export_dir, feeds,
+                                                plugin_path)
+    for chunk, outs in zip(chunks, all_outs):
+        for i in range(len(chunk)):
             row = dict(chunk[i])
             for tensor, arr in outs.items():
                 cell = arr[i]

@@ -496,7 +496,14 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
                 count += putter.reput_cached()
             # Wait for the consumer to drain the queue, surfacing user-code
             # errors and enforcing feed_timeout (reference TFSparkNode.py:407-418).
-            _join_with_error_check(mgr, queue, feed_timeout, "feeding")
+            # The deadline scales with epochs: executor-side replay drains
+            # ALL epochs inside this one task, where the reference's
+            # per-epoch partition tasks each got their own timeout — a
+            # fixed deadline would spuriously kill healthy multi-epoch runs
+            # on the in-queue (no-shm-ring) path.
+            _join_with_error_check(mgr, queue,
+                                   feed_timeout * max(num_epochs, 1),
+                                   "feeding")
             logger.info("fed %d items to %s queue", count, qname)
         # If the consumer began terminating while we fed, ask the driver to
         # stop scheduling feed partitions (reference TFSparkNode.py:422-434).

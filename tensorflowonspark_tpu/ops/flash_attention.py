@@ -44,6 +44,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     from jax.experimental import pallas as pl
 
     kk = pl.program_id(2)
+    # program_id must be read OUTSIDE pl.when bodies (interpret mode can't
+    # substitute it inside a cond branch); close over the values instead.
+    qi = pl.program_id(1)
 
     @pl.when(kk == 0)
     def _init():
@@ -51,25 +54,36 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0].astype(jnp.float32) * scale          # [BQ, D]
-    k = k_ref[0].astype(jnp.float32)                  # [BK, D]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # [BQ, BK]
-    if causal:
-        i = pl.program_id(1)
-        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + i * block_q
-        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + kk * block_k
-        s = jnp.where(rows >= cols, s, NEG_INF)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale      # [BQ, D]
+        k = k_ref[0].astype(jnp.float32)              # [BK, D]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                    + qi * block_q)
+            cols = (jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                    + kk * block_k)
+            s = jnp.where(rows >= cols, s, NEG_INF)
 
-    m_prev = m_scr[:]                                  # [BQ, 1]
-    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)                             # [BQ, BK]
-    alpha = jnp.exp(m_prev - m_new)                    # [BQ, 1]
-    l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1, keepdims=True)
-    v = v_ref[0].astype(jnp.float32)                   # [BK, D]
-    acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    m_scr[:] = m_new
+        m_prev = m_scr[:]                              # [BQ, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                         # [BQ, BK]
+        alpha = jnp.exp(m_prev - m_new)                # [BQ, 1]
+        l_scr[:] = l_scr[:] * alpha + p.sum(axis=-1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)               # [BK, D]
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    if causal:
+        # Skip tiles entirely above the diagonal: a fully-masked tile
+        # contributes p=0 / alpha=1 (exactly no-op), so predicating it off
+        # halves the causal kernel's MXU work.
+        pl.when(qi * block_q + block_q - 1 >= kk * block_k)(_compute)
+    else:
+        _compute()
 
     @pl.when(kk == n_k - 1)
     def _emit():
@@ -139,21 +153,29 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     from jax.experimental import pallas as pl
 
     kk = pl.program_id(2)
+    qi = pl.program_id(1)  # read outside pl.when bodies (interpret mode)
 
     @pl.when(kk == 0)
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    p = _recompute_p(q_ref, k_ref, lse_ref, scale, causal,
-                     pl.program_id(1), kk, block_q, block_k)
-    do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
-    v = v_ref[0].astype(jnp.float32)                   # [BK, D]
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0][:, None])              # [BQ, BK]
-    k = k_ref[0].astype(jnp.float32)
-    dq_scr[:] += scale * jax.lax.dot_general(
-        ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    def _compute():
+        p = _recompute_p(q_ref, k_ref, lse_ref, scale, causal,
+                         qi, kk, block_q, block_k)
+        do = do_ref[0].astype(jnp.float32)             # [BQ, D]
+        v = v_ref[0].astype(jnp.float32)               # [BK, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])          # [BQ, BK]
+        k = k_ref[0].astype(jnp.float32)
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= kk * block_k)(_compute)
+    else:
+        _compute()
 
     @pl.when(kk == n_k - 1)
     def _emit():
@@ -166,24 +188,33 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(2)
+    kk = pl.program_id(1)  # read outside pl.when bodies (interpret mode)
 
     @pl.when(qi == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    p = _recompute_p(q_ref, k_ref, lse_ref, scale, causal,
-                     qi, pl.program_id(1), block_q, block_k)
-    do = do_ref[0].astype(jnp.float32)                 # [BQ, D]
-    dv_scr[:] += jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    v = v_ref[0].astype(jnp.float32)                   # [BK, D]
-    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0][:, None])              # [BQ, BK]
-    q = q_ref[0].astype(jnp.float32)
-    dk_scr[:] += scale * jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    def _compute():
+        p = _recompute_p(q_ref, k_ref, lse_ref, scale, causal,
+                         qi, kk, block_q, block_k)
+        do = do_ref[0].astype(jnp.float32)             # [BQ, D]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        v = v_ref[0].astype(jnp.float32)               # [BK, D]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])          # [BQ, BK]
+        q = q_ref[0].astype(jnp.float32)
+        dk_scr[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= kk * block_k)(_compute)
+    else:
+        _compute()
 
     @pl.when(qi == n_q - 1)
     def _emit():

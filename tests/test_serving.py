@@ -219,14 +219,19 @@ def test_cli_native_path_batches_and_zips(tmp_path, monkeypatch):
 
     calls = []
 
-    def fake_runner(export_dir_, feed, plugin_path, **kw):
-        calls.append({k: v.shape for k, v in feed.items()})
-        # emulate the real module on the padded batch
-        out = model.apply({"params": params},
-                          user=feed["user"], item=feed["item"])
-        return {k: np.asarray(v) for k, v in out.items()}
+    def fake_runner_many(export_dir_, feeds, plugin_path, **kw):
+        # the CLI serves ALL padded chunks through one invocation
+        # (one compile); emulate the real module per batch
+        results = []
+        for feed in feeds:
+            calls.append({k: v.shape for k, v in feed.items()})
+            out = model.apply({"params": params},
+                              user=feed["user"], item=feed["item"])
+            results.append({k: np.asarray(v) for k, v in out.items()})
+        return results
 
-    monkeypatch.setattr(serving_mod, "run_embedded_native", fake_runner)
+    monkeypatch.setattr(serving_mod, "run_embedded_native_many",
+                        fake_runner_many)
 
     rng = np.random.default_rng(9)
     rows = [{"u": rng.random(3).astype(np.float32).tolist(),

@@ -288,6 +288,9 @@ class FileFeed(object):
 
     @staticmethod
     def _columnar(rows, dtypes):
+        # Row contract shared with marker.pack_columnar and
+        # datafeed._rows_to_fields (see pack_columnar's CONTRACT MIRRORS
+        # note); this variant adds dict rows and per-field dtype casts.
         first = rows[0]
         if isinstance(first, dict):
             return {

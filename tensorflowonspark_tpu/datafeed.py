@@ -26,7 +26,10 @@ _INTERRUPTED = object()  # internal next_batch abort marker (see interrupt())
 def _rows_to_fields(rows):
     """Convert a list of rows into per-field arrays: ``(fields, tuple_rows)``
     (the degraded path for object chunks; columnar chunks skip this).
-    Only tuples are rows-of-fields — see ``marker.pack_columnar``."""
+    Only tuples are rows-of-fields — the row contract is shared with
+    ``marker.pack_columnar`` and ``data.FileFeed._columnar`` (see the
+    CONTRACT MIRRORS note on pack_columnar); this variant hard-fails on
+    inconsistent arity where the feeder-side packer soft-falls-back."""
     first = rows[0]
     if isinstance(first, tuple):
         arity = len(first)

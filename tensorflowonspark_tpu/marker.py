@@ -89,6 +89,12 @@ def pack_columnar(block):
     scalar) is a single data value — a ``[1.0, 2.0]`` list row is a length-2
     vector, not two fields (matching ``DataFeed.next_batch_arrays``'s
     historical ``np.asarray(items)`` contract).
+
+    CONTRACT MIRRORS: ``datafeed._rows_to_fields`` (consumer-side degraded
+    path; hard-fails instead of falling back) and ``data.FileFeed._columnar``
+    (FILES path; adds dict rows + dtype casts) implement the same
+    tuple-vs-single-value row semantics — a change to the row contract must
+    update all three.
     """
     import numpy as np
 

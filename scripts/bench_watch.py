@@ -1,0 +1,126 @@
+"""Tunnel watcher: probe the TPU until it appears, then capture the round's
+device numbers immediately.
+
+The tunneled v5e flaps (observed round 3: up at 04:57, down by 05:24, still
+down 6 h later) — rounds that wait for a convenient moment get zero device
+numbers.  This watcher loops a cheap probe; the moment a fresh interpreter
+can see the chip it runs, in order:
+
+1. ``python bench.py`` (full headline legs) -> ``.bench_watch/bench.json``
+2. ``scripts/device_validate.py`` (pin_chips + profiler-trace evidence)
+   -> ``.bench_watch/device_validate.json``
+
+and exits 0.  If the bench ran but produced no device numbers (tunnel
+flapped mid-leg), it keeps watching and retries the device legs on the next
+probe success.  Exits 3 when the deadline passes with no device numbers.
+
+Run it in the background at round start:
+    python scripts/bench_watch.py --hours 11 &
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(ROOT, ".bench_watch")
+PROBE_CODE = "import jax; print(jax.devices()[0].device_kind)"
+
+
+def log(msg):
+    print("[bench_watch %s] %s" % (time.strftime("%H:%M:%S"), msg),
+          flush=True)
+
+
+def probe(timeout=120):
+    try:
+        proc = subprocess.run([sys.executable, "-c", PROBE_CODE],
+                              timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode == 0 and proc.stdout.strip():
+        return proc.stdout.strip().splitlines()[-1]
+    return None
+
+
+def run_bench():
+    out = os.path.join(OUT_DIR, "bench.json")
+    logf = os.path.join(OUT_DIR, "bench.log")
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(ROOT, ".jax_cache"))
+    with open(logf, "a") as lf:
+        proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                              cwd=ROOT, env=env, stdout=subprocess.PIPE,
+                              stderr=lf, text=True, timeout=4500)
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    if line:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    try:
+        return json.loads(line)
+    except (ValueError, IndexError):
+        return None
+
+
+def device_numbers_present(bench):
+    if not bench:
+        return False
+    return (bench.get("resnet50_step_time_ms") is not None
+            or bench.get("mnist_e2e_images_per_sec_per_chip") is not None)
+
+
+def run_validate():
+    logf = os.path.join(OUT_DIR, "device_validate.log")
+    script = os.path.join(ROOT, "scripts", "device_validate.py")
+    if not os.path.exists(script):
+        return
+    with open(logf, "a") as lf:
+        # umbrella > sum of device_validate's per-probe budgets (5 x 600s):
+        # cold remote compiles are minutes-slow; partial results persist
+        # anyway (device_validate rewrites its JSON after each probe)
+        subprocess.run([sys.executable, script,
+                        "--out", os.path.join(OUT_DIR,
+                                              "device_validate.json")],
+                       cwd=ROOT, stdout=lf, stderr=lf, timeout=3300)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hours", type=float, default=11.0)
+    ap.add_argument("--interval", type=float, default=150.0,
+                    help="seconds between probes while the tunnel is down")
+    args = ap.parse_args()
+    os.makedirs(OUT_DIR, exist_ok=True)
+    deadline = time.time() + args.hours * 3600
+
+    while time.time() < deadline:
+        kind = probe()
+        if not kind:
+            log("tunnel down; next probe in %ds" % int(args.interval))
+            time.sleep(args.interval)
+            continue
+        log("DEVICE UP: %s -- running bench" % kind)
+        try:
+            bench = run_bench()
+        except subprocess.TimeoutExpired:
+            log("bench.py exceeded its umbrella timeout")
+            bench = None
+        if device_numbers_present(bench):
+            log("device numbers captured: %s" % json.dumps(bench)[:200])
+            try:
+                run_validate()
+            except Exception as e:  # validation is best-effort evidence
+                log("device_validate failed: %s" % e)
+            return 0
+        log("bench ran but device legs empty (flap mid-run?); rewatching")
+        time.sleep(args.interval)
+    log("deadline reached with no device numbers")
+    return 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,9 +36,10 @@ def _fs_path(path):
     path it names.  Other schemes (``gs://`` etc.) pass through for
     orbax-compatible stores.
     """
-    if path.startswith("file://"):
-        return path[len("file://"):]
-    return path if "://" in path else os.path.abspath(path)
+    from tensorflowonspark_tpu import fsio
+
+    path = fsio.strip_file_scheme(path)
+    return path if fsio.is_remote(path) else os.path.abspath(path)
 
 
 class CheckpointManager(object):

@@ -26,13 +26,13 @@ Typical use inside ``main_fun``::
     trainer.fit_feed(sharded, steps_per_call=8)
 """
 
-import glob as _glob
 import logging
-import os
 import queue as _queue
 import threading
 
 import numpy as np
+
+from tensorflowonspark_tpu import fsio
 
 logger = logging.getLogger(__name__)
 
@@ -41,16 +41,18 @@ _INTERRUPTED = object()
 
 
 def list_shards(path, pattern="part-*"):
-    """Sorted shard files under ``path`` (a dir, a glob, or a single file).
+    """Sorted shard files under ``path`` (a dir, a glob, or a single file;
+    local or remote — ``gs://bucket/train`` works the same as a local dir,
+    see :mod:`~tensorflowonspark_tpu.fsio`).
 
     Directory case falls back from ``pattern`` to ``*.tfrecord*`` — the
     same lookup ``dfutil.load_tfrecords`` uses, so dirs with either naming
     convention work."""
-    if os.path.isdir(path):
-        files = (sorted(_glob.glob(os.path.join(path, pattern)))
-                 or sorted(_glob.glob(os.path.join(path, "*.tfrecord*"))))
+    if fsio.isdir(path):
+        files = (fsio.glob(fsio.join(path, pattern))
+                 or fsio.glob(fsio.join(path, "*.tfrecord*")))
     else:
-        files = sorted(_glob.glob(path)) or [path]
+        files = fsio.glob(path) or [path]
     if not files:
         raise FileNotFoundError("no shard files at {!r}".format(path))
     return files
@@ -116,7 +118,7 @@ def byte_lm_reader(seq_len, chunk_bytes=1 << 16):
     file's byte stream packs into fixed ``seq_len`` rows."""
     def reader(path):
         buf = bytearray()
-        with open(path, "rb") as f:
+        with fsio.open_file(path, "rb") as f:
             while True:
                 chunk = f.read(chunk_bytes)
                 if not chunk:

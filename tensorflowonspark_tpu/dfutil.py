@@ -17,12 +17,10 @@ guessed from the value count (len 1 = scalar) — documented lossy
 (reference ``DFUtilTest.scala:95-132``).
 """
 
-import glob
 import logging
-import os
 import weakref
 
-from tensorflowonspark_tpu import example_proto, tfrecord
+from tensorflowonspark_tpu import example_proto, fsio, tfrecord
 
 logger = logging.getLogger(__name__)
 
@@ -130,12 +128,12 @@ def save_as_tfrecords(rows, output_dir, schema=None, num_shards=1):
     rows = list(rows)
     if schema is None:
         schema = infer_row_schema(rows[0]) if rows else {}
-    os.makedirs(output_dir, exist_ok=True)
+    fsio.makedirs(output_dir)
     paths = []
     num_shards = max(num_shards, 1)
     per_shard = (len(rows) + num_shards - 1) // num_shards
     for shard in range(num_shards):
-        path = os.path.join(output_dir, "part-r-{:05d}".format(shard))
+        path = fsio.join(output_dir, "part-r-{:05d}".format(shard))
         with tfrecord.TFRecordWriter(path) as w:
             for row in rows[shard * per_shard:(shard + 1) * per_shard]:
                 w.write(to_example(row, schema))
@@ -149,9 +147,9 @@ def load_tfrecords(input_dir, binary_features=(), schema=None):
     """Load a TFRecord dir into :class:`Rows`, inferring the schema from the
     first record unless given (reference ``loadTFRecords``,
     ``dfutil.py:44-81``; schema probe 68-71)."""
-    paths = sorted(glob.glob(os.path.join(input_dir, "part-*")))
+    paths = fsio.glob(fsio.join(input_dir, "part-*"))
     if not paths:
-        paths = sorted(glob.glob(os.path.join(input_dir, "*.tfrecord*")))
+        paths = fsio.glob(fsio.join(input_dir, "*.tfrecord*"))
     if not paths:
         raise IOError("no TFRecord part files under {}".format(input_dir))
     out = Rows()
@@ -230,13 +228,13 @@ def saveAsTFRecords(df, output_dir, binary_features=()):
     ``output_dir`` must be on storage shared by driver and executors."""
     schema = df_schema(df, binary_features)
     columns = [f.name for f in df.schema.fields]
-    os.makedirs(output_dir, exist_ok=True)
+    fsio.makedirs(output_dir)
 
     def _write_part(index, iterator):
         from tensorflowonspark_tpu import dfutil as dfutil_mod
         from tensorflowonspark_tpu import tfrecord as tfr_mod
 
-        path = os.path.join(output_dir, "part-r-{:05d}".format(index))
+        path = fsio.join(output_dir, "part-r-{:05d}".format(index))
         count = 0
         with tfr_mod.TFRecordWriter(path) as w:
             for row in iterator:
@@ -259,9 +257,9 @@ def loadTFRecords(sc, input_dir, binary_features=(), schema_hint=None):
     from pyspark.sql import SparkSession
     from pyspark.sql import types as T
 
-    paths = sorted(glob.glob(os.path.join(input_dir, "part-*")))
+    paths = fsio.glob(fsio.join(input_dir, "part-*"))
     if not paths:
-        paths = sorted(glob.glob(os.path.join(input_dir, "*.tfrecord*")))
+        paths = fsio.glob(fsio.join(input_dir, "*.tfrecord*"))
     if not paths:
         raise IOError("no TFRecord part files under {}".format(input_dir))
 

@@ -18,7 +18,7 @@ import ctypes
 import logging
 import struct
 
-from tensorflowonspark_tpu import native
+from tensorflowonspark_tpu import fsio, native
 
 logger = logging.getLogger(__name__)
 
@@ -89,10 +89,13 @@ class TFRecordWriter(object):
     """Writes TFRecord files (C++ engine when available)."""
 
     def __init__(self, path, use_native=True):
+        path = fsio.strip_file_scheme(path)
         self.path = path
         self._handle = None
         self._file = None
-        lib = _lib() if use_native else None
+        # the C++ engine does its own fopen: local paths only; remote URLs
+        # (gs:// etc.) stream through fsspec via the python framing path
+        lib = (_lib() if use_native and not fsio.is_remote(path) else None)
         if lib is not None:
             self._lib = lib
             self._handle = lib.tfr_writer_open(path.encode())
@@ -100,7 +103,7 @@ class TFRecordWriter(object):
                 raise IOError("cannot open {} for writing".format(path))
         else:
             self._lib = None
-            self._file = open(path, "wb")
+            self._file = fsio.open_file(path, "wb")
 
     def write(self, record):
         record = bytes(record)
@@ -139,8 +142,13 @@ class TFRecordWriter(object):
 
 
 def tfrecord_iterator(path, use_native=True):
-    """Yield raw record bytes from a TFRecord file, verifying CRCs."""
-    lib = _lib() if use_native else None
+    """Yield raw record bytes from a TFRecord file, verifying CRCs.
+
+    Local files prefer the C++ engine; remote URLs (``gs://``, ``hdfs://``,
+    ``memory://``, ...) stream through :mod:`fsio`'s fsspec branch with the
+    same framing checks."""
+    path = fsio.strip_file_scheme(path)
+    lib = (_lib() if use_native and not fsio.is_remote(path) else None)
     if lib is not None:
         handle = lib.tfr_reader_open(path.encode())
         if not handle:
@@ -157,7 +165,7 @@ def tfrecord_iterator(path, use_native=True):
         finally:
             lib.tfr_reader_close(handle)
     else:
-        with open(path, "rb") as f:
+        with fsio.open_file(path, "rb") as f:
             while True:
                 header = f.read(8)
                 if not header:

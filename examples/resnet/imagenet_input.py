@@ -8,10 +8,22 @@ channel-mean subtraction).  This module is that pipeline rebuilt for the
 TPU framework:
 
 - ``imagenet_reader`` is a ``data.FileFeed`` row reader: native TFRecord
-  codec -> tf.train.Example wire parse -> PIL JPEG decode -> numpy crops.
+  codec -> tf.train.Example wire parse -> JPEG decode -> numpy crops.
+- The decode engine is **OpenCV (libjpeg) with reduced-resolution decode**
+  when available, PIL otherwise.  The crop window is sampled from the JPEG
+  *header* dimensions before any pixel is decoded, so the decoder can skip
+  straight to the largest power-of-two downscale that still covers the
+  crop — the same trick as the reference's ``decode_and_crop_jpeg``
+  partial decode (``imagenet_preprocessing.py:87-113``), traded for DCT
+  scaled decoding.  Measured (this image, 1 core, naturalistic 500x375
+  JPEG): PIL full 1.2k img/s, cv2 full 1.9k, cv2 reduced-2 3.2k,
+  reduced-4 4.5k.
 - Rows leave as **uint8 HWC** — 1 byte/pixel across the host->device link;
   the channel-mean normalization belongs ON DEVICE inside the jitted step
   (see :func:`normalize_on_device`), which is both faster and exact.
+- Decode is CPU-bound: to scale it past one core, wrap the reader in
+  ``data.ProcessPoolFeed`` (worker processes, one decode engine each) —
+  ``resnet_imagenet.py --decode_procs N``.
 
 Standard shard feature keys (same as the reference's ``_parse_example_proto``,
 ``imagenet_preprocessing.py``): ``image/encoded`` (JPEG bytes),
@@ -26,24 +38,38 @@ import numpy as np
 # subtracted on device after the uint8 batch lands.
 CHANNEL_MEANS = (123.68, 116.779, 103.939)
 
+_cv2 = None
 
-def _decode_jpeg(data):
+
+def _get_cv2():
+    """cv2 module or None; single-threaded (readers parallelize at the
+    row level — an internal cv2 pool would oversubscribe)."""
+    global _cv2
+    if _cv2 is None:
+        try:
+            import cv2
+
+            cv2.setNumThreads(1)
+            _cv2 = cv2
+        except ImportError:
+            _cv2 = False
+    return _cv2 or None
+
+
+def jpeg_size(data):
+    """(width, height) from the JPEG header — no pixel decode (PIL opens
+    lazily; ``.size`` only parses markers)."""
     from PIL import Image
 
-    img = Image.open(io.BytesIO(data))
-    if img.mode != "RGB":
-        img = img.convert("RGB")
-    return img
+    return Image.open(io.BytesIO(data)).size
 
 
-def random_resized_crop(img, size, rng, scale=(0.08, 1.0),
-                        ratio=(3 / 4, 4 / 3), attempts=10):
-    """Train-time crop (reference ``_decode_crop_and_flip``): sample a
-    random area/aspect window, fall back to a center crop when no sample
-    fits, resize to ``size`` x ``size``."""
-    from PIL import Image
-
-    w, h = img.size
+def sample_crop_box(w, h, rng, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                    attempts=10):
+    """Sample the reference's random area/aspect crop window from image
+    DIMENSIONS alone (reference ``_decode_crop_and_flip`` sampling,
+    ``imagenet_preprocessing.py:87-113``); None = no window fit (caller
+    falls back to a center crop)."""
     area = w * h
     for _ in range(attempts):
         target = area * rng.uniform(*scale)
@@ -51,26 +77,97 @@ def random_resized_crop(img, size, rng, scale=(0.08, 1.0),
         cw = int(round(np.sqrt(target * ar)))
         ch = int(round(np.sqrt(target / ar)))
         if 0 < cw <= w and 0 < ch <= h:
-            x = rng.integers(0, w - cw + 1)
-            y = rng.integers(0, h - ch + 1)
-            box = (x, y, x + cw, y + ch)
-            return img.resize((size, size), Image.BILINEAR, box=box)
-    return center_crop(img, size)
+            x = int(rng.integers(0, w - cw + 1))
+            y = int(rng.integers(0, h - ch + 1))
+            return x, y, cw, ch
+    return None
 
 
-def center_crop(img, size, resize_shorter=256):
-    """Eval-time crop (reference ``_central_crop`` + aspect-preserving
-    resize): shorter side to ``resize_shorter``, central ``size`` window."""
+def _reduce_factor(min_side, needed):
+    """Largest power-of-two downscale (<=8) whose result still covers
+    ``needed`` pixels on the shortest relevant side."""
+    k = 1
+    while k < 8 and (min_side >> (k.bit_length())) >= needed:
+        k <<= 1
+    return k
+
+
+_REDUCED_FLAGS = {}
+
+
+def _decode_rgb(data, reduce_k=1):
+    """JPEG bytes -> RGB uint8 ndarray at 1/reduce_k linear resolution.
+    cv2 (reduced-resolution decode) when importable, PIL (+draft) fallback."""
+    cv2 = _get_cv2()
+    if cv2 is not None:
+        if not _REDUCED_FLAGS:
+            _REDUCED_FLAGS.update({
+                1: cv2.IMREAD_COLOR, 2: cv2.IMREAD_REDUCED_COLOR_2,
+                4: cv2.IMREAD_REDUCED_COLOR_4, 8: cv2.IMREAD_REDUCED_COLOR_8})
+        arr = cv2.imdecode(np.frombuffer(data, np.uint8),
+                           _REDUCED_FLAGS[reduce_k])
+        if arr is not None:
+            return arr[:, :, ::-1]  # BGR -> RGB
+        # corrupt-for-cv2 image: fall through to PIL
     from PIL import Image
 
-    w, h = img.size
-    scale = resize_shorter / min(w, h)
-    img = img.resize((max(1, int(round(w * scale))),
-                      max(1, int(round(h * scale)))), Image.BILINEAR)
-    w, h = img.size
-    x = (w - size) // 2
-    y = (h - size) // 2
-    return img.crop((x, y, x + size, y + size))
+    img = Image.open(io.BytesIO(data))
+    if reduce_k > 1:
+        img.draft("RGB", (max(1, img.size[0] // reduce_k),
+                          max(1, img.size[1] // reduce_k)))
+    if img.mode != "RGB":
+        img = img.convert("RGB")
+    return np.asarray(img, np.uint8)
+
+
+def _resize(arr, out_w, out_h):
+    cv2 = _get_cv2()
+    if cv2 is not None:
+        return cv2.resize(np.ascontiguousarray(arr), (out_w, out_h),
+                          interpolation=cv2.INTER_LINEAR)
+    from PIL import Image
+
+    img = Image.fromarray(arr).resize((out_w, out_h), Image.BILINEAR)
+    return np.asarray(img, np.uint8)
+
+
+def random_resized_crop(data, size, rng, scale=(0.08, 1.0),
+                        ratio=(3 / 4, 4 / 3), attempts=10):
+    """Train-time path: sample the crop from header dims, decode at the
+    coarsest sufficient resolution, slice, resize to ``size`` x ``size``."""
+    w, h = jpeg_size(data)
+    box = sample_crop_box(w, h, rng, scale, ratio, attempts)
+    if box is None:
+        return center_crop(data, size)
+    x, y, cw, ch = box
+    k = _reduce_factor(min(cw, ch), size)
+    arr = _decode_rgb(data, k)
+    # Map the crop by the scale the decoder ACTUALLY applied (header dims
+    # vs array dims), not by the requested k: a fallback decoder that
+    # ignores the reduction request (PIL draft on progressive/non-JPEG
+    # data) would otherwise get a k-times-smaller top-left-pinned crop.
+    ah, aw = arr.shape[:2]
+    kx, ky = w / aw, h / ah
+    x0, y0 = min(int(x / kx), aw - 1), min(int(y / ky), ah - 1)
+    x1 = max(x0 + 1, min(int(round((x + cw) / kx)), aw))
+    y1 = max(y0 + 1, min(int(round((y + ch) / ky)), ah))
+    return _resize(arr[y0:y1, x0:x1], size, size)
+
+
+def center_crop(data, size, resize_shorter=256):
+    """Eval-time path (reference ``_central_crop`` + aspect-preserving
+    resize): shorter side to ``resize_shorter``, central ``size`` window."""
+    w, h = jpeg_size(data)
+    k = _reduce_factor(min(w, h), resize_shorter)
+    arr = _decode_rgb(data, k)
+    ah, aw = arr.shape[:2]
+    s = resize_shorter / min(aw, ah)
+    arr = _resize(arr, max(size, int(round(aw * s))),
+                  max(size, int(round(ah * s))))
+    ah, aw = arr.shape[:2]
+    x = (aw - size) // 2
+    y = (ah - size) // 2
+    return arr[y:y + size, x:x + size]
 
 
 def imagenet_reader(train=True, image_size=224, seed=0,
@@ -91,15 +188,14 @@ def imagenet_reader(train=True, image_size=224, seed=0,
             feats = example_proto.decode_example(rec)
             _, encoded = feats["image/encoded"]
             _, label = feats["image/class/label"]
-            img = _decode_jpeg(encoded[0])
             if train:
-                img = random_resized_crop(img, image_size, rng)
+                arr = random_resized_crop(encoded[0], image_size, rng)
                 if rng.random() < 0.5:
-                    img = img.transpose(0)  # FLIP_LEFT_RIGHT
+                    arr = arr[:, ::-1]  # horizontal flip
             else:
-                img = center_crop(img, image_size)
+                arr = center_crop(encoded[0], image_size)
             yield {
-                "image": np.asarray(img, np.uint8),
+                "image": np.ascontiguousarray(arr),
                 "label": np.int32(int(label[0]) + label_offset),
             }
 

@@ -147,18 +147,27 @@ def main_fun(args, ctx):
         from tensorflowonspark_tpu.parallel import infeed
         import imagenet_input
 
-        feed = data_mod.FileFeed(
-            data_mod.list_shards(
-                strip_scheme(ctx.absolute_path(args.data_dir)),
-                pattern="train-*"),
-            row_reader=imagenet_input.imagenet_reader(
-                train=True, image_size=size, seed=jax.process_index()),
-            shuffle_buffer=args.shuffle_buffer,
-            num_epochs=args.train_epochs,
-            reader_threads=args.reader_threads,
-            # decoded 224px uint8 rows are ~147 KB: bound the reader queue
-            # (blocks of FileFeed.BLOCK rows) so it can't buffer gigabytes
-            queue_size=8)
+        reader = imagenet_input.imagenet_reader(
+            train=True, image_size=size, seed=jax.process_index())
+        files = data_mod.list_shards(
+            strip_scheme(ctx.absolute_path(args.data_dir)), pattern="train-*")
+        if args.decode_procs:
+            # decode is CPU-bound: scale it across cores with worker
+            # processes (the tf.data num_parallel_calls role)
+            feed = data_mod.ProcessPoolFeed(
+                files, row_reader=reader,
+                shuffle_buffer=args.shuffle_buffer,
+                num_epochs=args.train_epochs, num_procs=args.decode_procs)
+        else:
+            feed = data_mod.FileFeed(
+                files, row_reader=reader,
+                shuffle_buffer=args.shuffle_buffer,
+                num_epochs=args.train_epochs,
+                reader_threads=args.reader_threads,
+                # decoded 224px uint8 rows are ~147 KB: bound the reader
+                # queue (blocks of FileFeed.BLOCK rows) so it can't buffer
+                # gigabytes
+                queue_size=8)
         sharded = infeed.ShardedFeed(
             feed, mesh, args.batch_size,
             transform=lambda cols: {
@@ -339,6 +348,10 @@ def main(argv=None):
                              "path)")
     parser.add_argument("--shuffle_buffer", type=int, default=10000)
     parser.add_argument("--reader_threads", type=int, default=4)
+    parser.add_argument("--decode_procs", type=int, default=0,
+                        help="JPEG-decode worker PROCESSES for the train "
+                        "feed (0 = in-process reader threads); decode is "
+                        "CPU-bound, so size this to the host's spare cores")
     parser.add_argument("--model_dir", default=None)
     parser.add_argument("--export_dir", default=None)
     parser.add_argument("--save_interval", type=int, default=1000)

@@ -260,6 +260,10 @@ class FileFeed(object):
                     self._reservoir[i] = self._reservoir[-1]
                     self._reservoir.pop()
                 return out
+        # end-of-stream: a reader that errored right before its end marker
+        # must still surface (the _END branch breaks without a check)
+        if not self._errors.empty():
+            raise self._errors.get()
         # drain the reservoir at end-of-stream
         if self._reservoir:
             out = self._reservoir
@@ -321,3 +325,175 @@ class FileFeed(object):
         self._reservoir = []
         self._pending = []
         self._done = True
+
+
+# ---------------------------------------------------------------------------
+# Multiprocess decode pool
+# ---------------------------------------------------------------------------
+
+def _pool_worker(reader_bytes, files, num_epochs, seed, worker_idx,
+                 block_rows, outq, stop_ev):
+    """Worker-process body: run the row reader over this worker's file
+    subset (via a private single-thread FileFeed, which supplies the
+    per-epoch file reshuffle and error relay) and stream row blocks back.
+
+    Protocol on ``outq``: ``("rows", [row, ...])`` | ``("error", repr)`` |
+    ``("end", worker_idx)``.
+    """
+    import queue as q
+
+    import cloudpickle
+
+    def put(item):
+        while not stop_ev.is_set():
+            try:
+                outq.put(item, timeout=0.2)
+                return True
+            except q.Full:
+                continue
+        return False
+
+    try:
+        reader = cloudpickle.loads(reader_bytes)
+        # shuffle_buffer=0: row mixing is the parent reservoir's job, so the
+        # worker feed's internal rng is unused and the seed passes through
+        feed = FileFeed(files, row_reader=reader, shuffle_buffer=0,
+                        num_epochs=num_epochs, reader_threads=1,
+                        seed=seed, shard=False)
+        feed._ensure_started()  # _next_rows is end-of-stream until started
+        pending = []
+        while not stop_ev.is_set():
+            block = feed._next_rows()
+            if block is None:
+                break
+            pending.extend(block)
+            while len(pending) >= block_rows:
+                if not put(("rows", pending[:block_rows])):
+                    return
+                pending = pending[block_rows:]
+        if pending and not stop_ev.is_set():
+            put(("rows", pending))
+    except BaseException as exc:  # noqa: B036 — relayed to the consumer
+        put(("error", "{}: {}".format(type(exc).__name__, exc)))
+    finally:
+        # end marker must LAND (not best-effort): a dropped marker means the
+        # parent's end-accounting never completes and the consumer hangs at
+        # end of data.  The retry loop blocks until space or stop_ev — on
+        # the stop path the parent no longer reads markers anyway.
+        put(("end", worker_idx))
+        if stop_ev.is_set():
+            # terminating: don't let this process's queue feeder thread
+            # block exit flushing buffered blocks into a full pipe, and
+            # skip interpreter/C++ teardown entirely — abruptly-stopped
+            # decoder libs abort ("terminate called without an active
+            # exception") in their static destructors
+            outq.cancel_join_thread()
+            import os
+
+            os._exit(0)
+
+
+class ProcessPoolFeed(FileFeed):
+    """FileFeed with the row readers in worker PROCESSES.
+
+    JPEG decode (and any other CPU-heavy row transform) is GIL-bound in
+    FileFeed's reader threads; this variant shards the file list over
+    ``num_procs`` spawned processes — each decodes independently on its own
+    core — and streams row blocks back over a single bounded mp queue.
+    The consumer surface (``next_batch_arrays`` / reservoir shuffle /
+    ``terminate``) is inherited unchanged, so ``ShardedFeed`` composes
+    identically.
+
+    The reference gets this concurrency from tf.data's C++ thread pool
+    (``imagenet_preprocessing.py:87-175`` + ``num_parallel_calls``); a
+    Python framework needs processes for the same effect.
+
+    Args:
+      files: shard files (process-sharded here unless ``shard=False``,
+        then worker-sharded internally).
+      row_reader: as FileFeed; cloudpickled to the workers.
+      num_procs: worker process count (decode cores to use).
+      block_rows: rows per IPC message (bounds message size: 32 rows of
+        224x224x3 uint8 is ~4.8 MB).
+      queue_blocks: bounded queue depth (backpressure on fast decoders).
+    """
+
+    def __init__(self, files, row_reader=None, shuffle_buffer=0,
+                 num_epochs=1, num_procs=2, seed=0, shard=True,
+                 block_rows=32, queue_blocks=16):
+        super(ProcessPoolFeed, self).__init__(
+            files, row_reader=row_reader, shuffle_buffer=shuffle_buffer,
+            num_epochs=num_epochs, reader_threads=1, seed=seed, shard=shard)
+        self.num_procs = max(1, min(num_procs, len(self.files)))
+        self.block_rows = block_rows
+        self.queue_blocks = queue_blocks
+        self._procs = []
+        self._stop_ev = None
+        self._outq = None
+
+    def _ensure_started(self):
+        if self._started:
+            return
+        self._started = True
+        import cloudpickle
+        import multiprocessing as mp
+
+        ctx = mp.get_context("spawn")
+        self._stop_ev = ctx.Event()
+        self._outq = ctx.Queue(maxsize=self.queue_blocks)
+        reader_bytes = cloudpickle.dumps(self.row_reader)
+        for i in range(self.num_procs):
+            p = ctx.Process(
+                target=_pool_worker,
+                args=(reader_bytes, self.files[i::self.num_procs],
+                      self.num_epochs, self._seed, i, self.block_rows,
+                      self._outq, self._stop_ev),
+                name="poolfeed-worker-%d" % i, daemon=True)
+            p.start()
+            self._procs.append(p)
+        # one forwarder thread: mp queue -> the inherited consumer queue
+        t = threading.Thread(target=self._forward, name="poolfeed-forward",
+                             daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _forward(self):
+        ended = 0
+        try:
+            while ended < self.num_procs and not self._interrupt.is_set():
+                try:
+                    kind, payload = self._outq.get(timeout=0.2)
+                except _queue.Empty:
+                    continue
+                if kind == "end":
+                    ended += 1
+                elif kind == "error":
+                    self._errors.put(IOError(payload))
+                    return
+                elif not self._put(payload):
+                    return
+        finally:
+            # stop the workers on EVERY forwarder exit: on the error path
+            # nothing else would, and surviving workers would spin retrying
+            # puts into a full queue forever (normal end: workers already
+            # exited, setting the event is a no-op)
+            if self._stop_ev is not None:
+                self._stop_ev.set()
+            self._put(_END, force=True)
+
+    def terminate(self):
+        if self._stop_ev is not None:
+            self._stop_ev.set()
+        super(ProcessPoolFeed, self).terminate()
+        for p in self._procs:
+            p.join(timeout=5)
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=5)
+        # NEVER get() from the queue here: a killed producer can leave a
+        # partial message and a "non-blocking" get would block in
+        # recv_bytes.  The parent holds no unsent puts, so just detach.
+        if self._outq is not None:
+            self._outq.cancel_join_thread()
+            self._outq.close()

@@ -80,8 +80,12 @@ def absolute_path(ctx, path):
 
 
 def strip_scheme(path):
-    """Drop a ``file://`` prefix for direct POSIX access."""
-    return path[len("file://"):] if path.startswith("file://") else path
+    """Drop a ``file://``/``file:`` prefix for direct POSIX access (shared
+    canonical helper — keeps this and the checkpoint/data paths agreeing
+    on what counts as a local path)."""
+    from tensorflowonspark_tpu import fsio
+
+    return fsio.strip_file_scheme(path)
 
 
 class DataFeed(object):

@@ -215,3 +215,76 @@ def test_file_order_reshuffles_each_epoch(tmp_path):
     epochs = [vals[i * 6:(i + 1) * 6] for i in range(4)]
     # the reservoir is tiny (1), so order ~= file order: epochs must differ
     assert len({tuple(e) for e in epochs}) > 1, epochs
+
+
+class TestProcessPoolFeed:
+    """Pool-specific protocol tests (decode-shaped tests live in
+    test_imagenet_input.py): end-marker delivery under backpressure and
+    worker shutdown on the error path."""
+
+    @pytest.fixture
+    def int_shards(self, tmp_path):
+        rows = dfutil.Rows([{"id": i} for i in range(300)],
+                           schema={"id": "int64"})
+        out = str(tmp_path / "tfr")
+        dfutil.save_as_tfrecords(rows, out, num_shards=3)
+        return data_mod.list_shards(out)
+
+    def test_end_marker_survives_full_queue(self, int_shards):
+        """Workers must deliver their end markers even when the consumer
+        stalls long enough to fill every queue (block_rows=1 makes 300
+        blocks against a 2-block mp queue + 64-block parent queue)."""
+        import threading
+        import time as time_mod
+
+        feed = data_mod.ProcessPoolFeed(int_shards, num_procs=2,
+                                        shard=False, block_rows=1,
+                                        queue_blocks=2)
+        feed._ensure_started()
+        # stall until both workers have read everything and are parked on
+        # (or past) their final put
+        deadline = time_mod.time() + 60
+        while any(p.is_alive() for p in feed._procs):
+            if time_mod.time() > deadline:
+                break  # backpressure keeps them alive; drain will finish them
+            time_mod.sleep(0.2)
+        got = []
+        done = threading.Event()
+
+        def drain():
+            while not feed.should_stop():
+                arrays, count = feed.next_batch_arrays(32)
+                if count == 0:
+                    break
+                got.extend(int(v) for v in arrays["id"][:count])
+            done.set()
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        assert done.wait(timeout=60), \
+            "consumer hung at end of data: end marker lost"
+        assert sorted(got) == list(range(300))
+        feed.terminate()
+
+    def test_error_path_stops_surviving_workers(self, tmp_path):
+        """A worker error must stop the OTHER workers too (forwarder sets
+        the stop event), not leave them spinning against a full queue."""
+        rows = dfutil.Rows([{"id": i} for i in range(100)],
+                           schema={"id": "int64"})
+        good = str(tmp_path / "good")
+        dfutil.save_as_tfrecords(rows, good, num_shards=1)
+        bad = tmp_path / "bad.tfrecord"
+        bad.write_bytes(b"garbage that is not a tfrecord")
+        files = [str(bad)] + data_mod.list_shards(good)
+        feed = data_mod.ProcessPoolFeed(files, num_procs=2, shard=False,
+                                        num_epochs=200, block_rows=4,
+                                        queue_blocks=2)
+        with pytest.raises(IOError):
+            while True:
+                _, count = feed.next_batch_arrays(8)
+                if count == 0:
+                    break
+        for p in feed._procs:
+            p.join(timeout=30)
+            assert not p.is_alive(), "surviving worker not stopped on error"
+        feed.terminate()

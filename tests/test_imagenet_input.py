@@ -1,0 +1,173 @@
+"""ImageNet decode pipeline unit tests: crop geometry, reduced-resolution
+decode, engine fallback, and the multiprocess decode pool."""
+
+import io
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", "examples", "resnet"))
+import imagenet_input  # noqa: E402
+
+from tensorflowonspark_tpu import data as data_mod  # noqa: E402
+
+
+def _jpeg(w, h, seed=0, gray=False):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    if gray:
+        arr = rng.integers(0, 256, (h, w), np.uint8)
+        img = Image.fromarray(arr, "L")
+    else:
+        arr = rng.integers(0, 256, (h, w, 3), np.uint8)
+        img = Image.fromarray(arr)
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+class TestDecode:
+    def test_jpeg_size_without_decode(self):
+        assert imagenet_input.jpeg_size(_jpeg(500, 375)) == (500, 375)
+
+    def test_decode_full_and_reduced_dims(self):
+        data = _jpeg(500, 376)
+        full = imagenet_input._decode_rgb(data, 1)
+        assert full.shape == (376, 500, 3) and full.dtype == np.uint8
+        half = imagenet_input._decode_rgb(data, 2)
+        assert half.shape == (188, 250, 3)
+        quarter = imagenet_input._decode_rgb(data, 4)
+        assert quarter.shape == (94, 125, 3)
+
+    def test_decode_matches_pil_colors(self):
+        """cv2 path must give RGB (not BGR): compare channel means against
+        a PIL decode of the same image."""
+        from PIL import Image
+
+        data = _jpeg(64, 64, seed=3)
+        arr = imagenet_input._decode_rgb(data, 1)
+        ref = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+        # JPEG decoders may differ by rounding; means must match per channel
+        assert np.allclose(arr.mean(axis=(0, 1)), ref.mean(axis=(0, 1)),
+                           atol=1.0)
+
+    def test_grayscale_jpeg_gets_three_channels(self):
+        arr = imagenet_input._decode_rgb(_jpeg(80, 60, gray=True), 1)
+        assert arr.shape == (60, 80, 3)
+
+    def test_reduce_factor(self):
+        f = imagenet_input._reduce_factor
+        assert f(224, 224) == 1
+        assert f(447, 224) == 1
+        assert f(448, 224) == 2
+        assert f(896, 224) == 4
+        assert f(10000, 224) == 8  # capped
+        assert f(100, 224) == 1
+
+    def test_random_resized_crop_shape_any_source(self):
+        rng = np.random.default_rng(0)
+        for w, h in [(500, 375), (224, 224), (90, 60), (1600, 1200)]:
+            out = imagenet_input.random_resized_crop(_jpeg(w, h), 224, rng)
+            assert out.shape == (224, 224, 3) and out.dtype == np.uint8
+
+    def test_center_crop_shape_and_centering(self):
+        out = imagenet_input.center_crop(_jpeg(500, 375), 224)
+        assert out.shape == (224, 224, 3)
+        # tiny source still yields the right shape
+        out = imagenet_input.center_crop(_jpeg(100, 80), 224)
+        assert out.shape == (224, 224, 3)
+
+    def test_sample_crop_box_within_bounds(self):
+        rng = np.random.default_rng(1)
+        for _ in range(50):
+            box = imagenet_input.sample_crop_box(500, 375, rng)
+            if box is None:
+                continue
+            x, y, cw, ch = box
+            assert 0 <= x and x + cw <= 500
+            assert 0 <= y and y + ch <= 375
+            assert cw > 0 and ch > 0
+
+
+class TestReader:
+    @pytest.fixture
+    def shards(self, tmp_path):
+        out = str(tmp_path / "shards")
+        imagenet_input.write_synthetic_shards(out, num_examples=24,
+                                              num_shards=3, image_size=96)
+        return out
+
+    def test_reader_rows(self, shards):
+        files = data_mod.list_shards(shards, pattern="train-*")
+        reader = imagenet_input.imagenet_reader(train=True, image_size=64)
+        rows = [r for f in files for r in reader(f)]
+        assert len(rows) == 24
+        for r in rows:
+            assert r["image"].shape == (64, 64, 3)
+            assert r["image"].dtype == np.uint8
+            assert 0 <= int(r["label"]) < 1000
+
+    def test_eval_reader_deterministic(self, shards):
+        files = data_mod.list_shards(shards, pattern="train-*")
+        reader = imagenet_input.imagenet_reader(train=False, image_size=64)
+        a = [r["image"] for r in reader(files[0])]
+        b = [r["image"] for r in reader(files[0])]
+        assert all(np.array_equal(x, y) for x, y in zip(a, b))
+
+    def test_pool_feed_reads_all_rows(self, shards):
+        files = data_mod.list_shards(shards, pattern="train-*")
+        feed = data_mod.ProcessPoolFeed(
+            files, row_reader=imagenet_input.imagenet_reader(
+                train=False, image_size=64),
+            num_procs=2, shard=False, block_rows=8)
+        labels = []
+        while not feed.should_stop():
+            arrays, count = feed.next_batch_arrays(10)
+            if count == 0:
+                break
+            assert arrays["image"].shape[1:] == (64, 64, 3)
+            labels.extend(arrays["label"][:count].tolist())
+        assert len(labels) == 24
+
+    def test_pool_feed_epochs_and_shuffle(self, shards):
+        files = data_mod.list_shards(shards, pattern="train-*")
+        feed = data_mod.ProcessPoolFeed(
+            files, row_reader=imagenet_input.imagenet_reader(
+                train=False, image_size=32),
+            num_procs=2, shard=False, num_epochs=2, shuffle_buffer=16,
+            block_rows=8)
+        seen = 0
+        while not feed.should_stop():
+            _, count = feed.next_batch_arrays(16)
+            if count == 0:
+                break
+            seen += count
+        assert seen == 48
+
+    def test_pool_feed_terminate_early(self, shards):
+        files = data_mod.list_shards(shards, pattern="train-*")
+        feed = data_mod.ProcessPoolFeed(
+            files, row_reader=imagenet_input.imagenet_reader(
+                train=False, image_size=32),
+            num_procs=2, shard=False, num_epochs=50, block_rows=8)
+        _, count = feed.next_batch_arrays(4)
+        assert count == 4
+        feed.terminate()  # must not hang with epochs of data queued
+        assert feed.should_stop()
+        for p in feed._procs:
+            p.join(timeout=10)
+            assert not p.is_alive()
+
+    def test_pool_feed_error_propagates(self, tmp_path):
+        bad = tmp_path / "bad.tfrecord"
+        bad.write_bytes(b"not a tfrecord at all")
+        feed = data_mod.ProcessPoolFeed(
+            [str(bad)], row_reader=imagenet_input.imagenet_reader(),
+            num_procs=1, shard=False)
+        with pytest.raises(IOError):
+            feed.next_batch_arrays(4)
+        feed.terminate()

@@ -1,0 +1,44 @@
+"""CI gate: the C++ engines must be the ones under test.
+
+Every native-backed module has a pure-python fallback for hosts without a
+toolchain — correct for users, WRONG for CI, where a missing compiler or
+header would silently demote the suite to fallback coverage.  Imported by
+.github/workflows/ci.yml (single source for every job).
+
+Exit 0 = all required engines built.  The PJRT serving pair (runner +
+mock plugin) additionally needs ``pjrt_c_api.h`` from an installed
+tensorflow wheel; pass ``--require-pjrt`` in jobs that install one.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--require-pjrt", action="store_true")
+    args = ap.parse_args()
+
+    from tensorflowonspark_tpu import native, tfrecord
+
+    assert tfrecord._lib() is not None, "C++ tfrecord codec not built"
+    assert native.load("shmring") is not None, "C++ shm ring not built"
+    print("native engines OK: tfrecord, shmring")
+    if args.require_pjrt:
+        dirs = native.pjrt_include_dirs()
+        assert dirs, "pjrt_c_api.h not found (tensorflow wheel missing?)"
+        assert native.build_executable(
+            "pjrt_runner", include_dirs=dirs) is not None, \
+            "pjrt_runner failed to build"
+        assert native.build_shared(
+            "mock_pjrt_plugin", include_dirs=dirs) is not None, \
+            "mock PJRT plugin failed to build"
+        print("native engines OK: pjrt_runner, mock_pjrt_plugin")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

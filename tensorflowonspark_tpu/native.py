@@ -68,6 +68,31 @@ def build_executable(name, include_dirs=(), libs=("dl",), timeout=240):
         return out
 
 
+def build_shared(name, include_dirs=(), timeout=240, sources=None):
+    """Build ``native/<name>.cc`` into ``native/lib<name>.so`` and return
+    the PATH (not a loaded handle — for libraries someone else dlopens,
+    like a PJRT plugin), or None when the toolchain/headers are absent."""
+    key = ("so-path", name)
+    with _lock:
+        if key in _cache:
+            return _cache[key]
+        out = None
+        try:
+            src = os.path.join(_NATIVE_DIR, (sources or name + ".cc"))
+            so = os.path.join(_NATIVE_DIR, "lib{}.so".format(name))
+            if os.path.exists(src):
+                _compile(src, so,
+                         ["-shared", "-fPIC"]
+                         + ["-I" + d for d in include_dirs], timeout)
+                out = so
+        except Exception:
+            logger.warning("native shared lib %s unavailable", name,
+                           exc_info=True)
+            out = None
+        _cache[key] = out
+        return out
+
+
 def pjrt_include_dirs():
     """Best-effort include dirs carrying ``pjrt_c_api.h`` from installed
     wheels (tensorflow ships the XLA headers in this image)."""
@@ -86,16 +111,18 @@ def pjrt_include_dirs():
 
 def load(name, sources=None):
     """Load ``lib<name>.so``, building it from ``native/<name>.cc`` first if
-    missing or stale; returns a ``ctypes.CDLL`` or None on any failure."""
+    missing or stale (via :func:`build_shared`); returns a ``ctypes.CDLL``
+    or None on any failure."""
     with _lock:
         if name in _cache:
             return _cache[name]
+    so = build_shared(name, timeout=120, sources=sources)
+    with _lock:
+        if name in _cache:  # lost a race with another loader
+            return _cache[name]
         lib = None
         try:
-            src = os.path.join(_NATIVE_DIR, (sources or name + ".cc"))
-            so = os.path.join(_NATIVE_DIR, "lib{}.so".format(name))
-            if os.path.exists(src):
-                _compile(src, so, ["-shared", "-fPIC"], timeout=120)
+            if so is not None:
                 lib = ctypes.CDLL(so)
         except Exception:
             logger.warning("native %s unavailable; using pure-python fallback",

@@ -22,11 +22,26 @@ import sys
 # the WHOLE suite (before any framework import) so import-gated pyspark code
 # (pipeline ml-subclassing, SparkBackend, DataFrame dfutil) is active and
 # exercised; PYTHONPATH propagates it to spawned executor processes.
+# TFOS_REAL_PYSPARK=1 (the CI spark-real leg) skips the shim so the same
+# tests run against an installed real pyspark + JVM — the reference's live
+# Spark Standalone rig (reference test/run_tests.sh:15-22).
 _SHIM = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sparkshim")
-if _SHIM not in sys.path:
-    sys.path.insert(0, _SHIM)
-os.environ["PYTHONPATH"] = os.pathsep.join(
-    p for p in (_SHIM, os.environ.get("PYTHONPATH", "")) if p)
+_use_shim = True
+if os.environ.get("TFOS_REAL_PYSPARK"):
+    try:
+        import pyspark  # noqa: F401  (probe: is the real package here?)
+    except ImportError as e:
+        # fail LOUDLY: falling back to the shim here would let a run that
+        # claims real-JVM validation silently test the double instead
+        raise RuntimeError(
+            "TFOS_REAL_PYSPARK=1 but pyspark is not importable — install "
+            "pyspark (and a JVM) or unset the variable") from e
+    _use_shim = False
+if _use_shim:
+    if _SHIM not in sys.path:
+        sys.path.insert(0, _SHIM)
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in (_SHIM, os.environ.get("PYTHONPATH", "")) if p)
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""  # de-activate TPU plugin hook in children

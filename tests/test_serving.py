@@ -252,3 +252,69 @@ def test_cli_native_path_batches_and_zips(tmp_path, monkeypatch):
         np.testing.assert_allclose(out["emb"],
                                    np.asarray(ref["user_embedding"][k]),
                                    rtol=1e-5)
+
+
+def test_native_runner_executes_with_mock_plugin(tmp_path, monkeypatch):
+    """The C++ PJRT runner EXECUTES (not just compiles) in every
+    environment: a first-party mock plugin (native/mock_pjrt_plugin.cc)
+    implements the exact C-API subset the runner drives, with
+    deterministic semantics this test asserts — the program bytes reach
+    the plugin intact, and every output element equals a checksum of the
+    bytes the runner staged for that batch (so --batches slicing or
+    argument-marshalling bugs change the value).  Numeric model-output
+    validation stays on real plugins (test_embedded_native_serving)."""
+    from tensorflowonspark_tpu import native
+
+    dirs = native.pjrt_include_dirs()
+    if not dirs:
+        pytest.skip("no pjrt_c_api.h available (tensorflow wheel absent)")
+    plugin = native.build_shared("mock_pjrt_plugin", include_dirs=dirs)
+    runner = native.build_executable("pjrt_runner", include_dirs=dirs)
+    if plugin is None or runner is None:
+        pytest.skip("C++ toolchain unavailable")
+
+    model = get_model("two_tower", embed_dim=4)
+    params = model.init(jax.random.PRNGKey(0), user=jnp.zeros((1, 3)),
+                        item=jnp.zeros((1, 3)))["params"]
+    params = jax.tree_util.tree_map(np.asarray, params)
+    export_dir = str(tmp_path / "export")
+    checkpoint.export_model(
+        export_dir, params, "two_tower", model_config={"embed_dim": 4},
+        input_signature={"user": {"shape": [None, 3], "dtype": "float32"},
+                         "item": {"shape": [None, 3], "dtype": "float32"}},
+        model=model, embed_batch_size=4, embed_platform="cpu")
+    with open(os.path.join(export_dir, "export.json")) as f:
+        emb = json.load(f)["embedded_mlir"]
+
+    dump = str(tmp_path / "program_dump.mlir")
+    monkeypatch.setenv("TFOS_MOCK_PROGRAM_DUMP", dump)
+    monkeypatch.setenv("TFOS_MOCK_OUTPUTS", ";".join(
+        "{}:{}".format(o["dtype"], ",".join(str(d) for d in o["shape"]))
+        for o in emb["outputs"]))
+
+    rng = np.random.default_rng(7)
+    feeds = [{"user": rng.random((4, 3), np.float32),
+              "item": rng.random((4, 3), np.float32)} for _ in range(3)]
+    outs = serving.run_embedded_native_many(export_dir, feeds, plugin)
+
+    # the mock received the exact exported StableHLO bytes
+    with open(os.path.join(export_dir, emb["file"]), "rb") as f:
+        program = f.read()
+    with open(dump, "rb") as f:
+        assert f.read() == program
+
+    # checksum semantics: per batch, over the flattened-argument bytes in
+    # the module's (sorted-name) argument order
+    arg_names = [i["name"] for i in emb["inputs"]]
+    assert len(outs) == 3
+    for feed, out in zip(feeds, outs):
+        sum_bytes = 0
+        for name in arg_names:
+            sum_bytes += int(np.frombuffer(
+                np.ascontiguousarray(feed[name]).tobytes(),
+                np.uint8).sum())
+        base = (sum_bytes % 1000003) % 1000
+        for i, spec in enumerate(emb["outputs"]):
+            arr = out[spec["name"]]
+            assert list(arr.shape) == list(spec["shape"])
+            np.testing.assert_allclose(arr, float(base + i))

@@ -194,6 +194,17 @@ class LocalBackend(object):
         conn = self._conns[executor_index]
         try:
             conn.send((task_id, fn_bytes, items))
+            # recv with a LIVENESS poll, not a bare recv: an executor whose
+            # task spawned children (every node runtime forks a manager
+            # server) leaves those children holding a dup of the pipe fd,
+            # so a SIGKILLed executor never EOFs the pipe — the job would
+            # wedge forever instead of failing (observed: vanished-executor
+            # shutdown hang).
+            while not conn.poll(1.0):
+                if not self._procs[executor_index].is_alive():
+                    if conn.poll(0.5):
+                        break  # final response raced with process exit
+                    raise EOFError("executor process died")
             rid, ok, payload = conn.recv()
             assert rid == task_id
             handle._task_done(task_id, ok, payload)

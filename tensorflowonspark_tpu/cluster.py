@@ -235,10 +235,54 @@ class TPUCluster(object):
                 self._latch_error(e)  # first error wins: keep the root cause
                 break
         else:
-            if worker_ids - covered and "error" not in self.tf_status:
-                logger.warning(
-                    "could not confirm shutdown of nodes %s; their executors "
-                    "may have died", sorted(worker_ids - covered))
+            missing = sorted(worker_ids - covered)
+            if missing and "error" not in self.tf_status:
+                # Distinguish "finished already" (benign: poisoning found no
+                # node because the node completed and stopped) from a
+                # VANISHED executor.  Probe each unconfirmed node's manager:
+                # a reachable manager reporting finished/stopped is fine;
+                # anything else means the executor died without reporting —
+                # fail loudly like the reference (TFCluster.py:177-181),
+                # not a warning + exit 0 a scheduler would read as success.
+                from tensorflowonspark_tpu import util as util_mod
+
+                by_id = {n["executor_id"]: n for n in workers}
+                driver_ip = util_mod.get_ip_address()
+                dead, unknown = [], []
+                for i in missing:
+                    n = by_id[i]
+                    state = None
+                    try:
+                        from tensorflowonspark_tpu import manager as mgr_mod
+
+                        m = mgr_mod.connect(n["addr"],
+                                            bytes.fromhex(n["authkey"]))
+                        state = m.get("state")
+                    except Exception:
+                        pass
+                    if state in ("finished", "stopped"):
+                        logger.info("node %d already %s; shutdown coverage "
+                                    "not needed", i, state)
+                        continue
+                    # A failed probe is only AUTHORITATIVE when the driver
+                    # could have reached the manager at all: worker managers
+                    # are same-host unix sockets (node.py mode='local'), so
+                    # from a remote driver an unreachable socket proves
+                    # nothing about the executor.
+                    authoritative = (isinstance(n["addr"], (tuple, list))
+                                     or n.get("host") == driver_ip)
+                    (dead if authoritative else unknown).append((i, state))
+                if unknown:
+                    logger.warning(
+                        "could not confirm shutdown of remote nodes %s and "
+                        "their managers are not driver-reachable; check the "
+                        "executor logs", [i for i, _ in unknown])
+                if dead:
+                    self._latch_error(RuntimeError(
+                        "worker nodes never confirmed shutdown and are not "
+                        "finished: {} (executor died or is unreachable)"
+                        .format(["node {} state={}".format(i, s)
+                                 for i, s in dead])))
 
         if "error" in self.tf_status:
             logger.error("cluster failed: %s", self.tf_status["error"])

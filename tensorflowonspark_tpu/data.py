@@ -294,9 +294,11 @@ class FileFeed(object):
 
     @staticmethod
     def _columnar(rows, dtypes):
-        # Row contract shared with marker.pack_columnar and
-        # datafeed._rows_to_fields (see pack_columnar's CONTRACT MIRRORS
-        # note); this variant adds dict rows and per-field dtype casts.
+        # Dict rows (FILES-specific surface: TFRecord features by name)
+        # assemble here; tuple/single rows delegate to the shared contract
+        # (tensorflowonspark_tpu.columnar), strict like the consumer side.
+        from tensorflowonspark_tpu import columnar
+
         first = rows[0]
         if isinstance(first, dict):
             return {
@@ -304,12 +306,9 @@ class FileFeed(object):
                               dtype=None if not dtypes else dtypes.get(k))
                 for k in first
             }
-        if isinstance(first, tuple):
-            return tuple(
-                np.asarray([r[f] for r in rows],
-                           dtype=None if not dtypes else dtypes[f])
-                for f in range(len(first)))
-        return np.asarray(rows, dtype=None if not dtypes else dtypes)
+        fields, tuple_rows = columnar.rows_to_fields(
+            rows, strict=True, dtypes=dtypes if dtypes else None)
+        return fields if tuple_rows else fields[0]
 
     def should_stop(self):
         return self._done and not self._pending

@@ -26,25 +26,13 @@ _INTERRUPTED = object()  # internal next_batch abort marker (see interrupt())
 def _rows_to_fields(rows):
     """Convert a list of rows into per-field arrays: ``(fields, tuple_rows)``
     (the degraded path for object chunks; columnar chunks skip this).
-    Only tuples are rows-of-fields — the row contract is shared with
-    ``marker.pack_columnar`` and ``data.FileFeed._columnar`` (see the
-    CONTRACT MIRRORS note on pack_columnar); this variant hard-fails on
-    inconsistent arity where the feeder-side packer soft-falls-back."""
-    first = rows[0]
-    if isinstance(first, tuple):
-        arity = len(first)
-        for r in rows:
-            if not isinstance(r, tuple) or len(r) != arity:
-                # Truncating to the first row's arity would silently drop
-                # fields of wider rows — wrong training data; fail loudly.
-                raise ValueError(
-                    "inconsistent row arity in feed chunk: expected {}-field "
-                    "tuples, got {!r}".format(arity, type(r).__name__
-                                              if not isinstance(r, tuple)
-                                              else len(r)))
-        return (tuple(np.asarray([r[f] for r in rows])
-                      for f in range(arity)), True)
-    return (np.asarray(rows),), False
+    Row semantics live in :mod:`~tensorflowonspark_tpu.columnar`; this is
+    the strict caller — inconsistent arity raises (truncating would
+    silently drop fields — wrong training data) where the feeder-side
+    packer soft-falls-back."""
+    from tensorflowonspark_tpu import columnar
+
+    return columnar.rows_to_fields(rows, strict=True)
 
 
 def absolute_path(ctx, path):

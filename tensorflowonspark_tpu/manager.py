@@ -118,9 +118,29 @@ def start(authkey, queues, mode="local"):
         mgr = TPUManager(address=("", 0), authkey=authkey, ctx=ctx)
     else:
         mgr = TPUManager(authkey=authkey, ctx=ctx)
-    mgr.start()
+    mgr.start(initializer=_die_with_parent)
     logger.info("started %s manager at %s", mode, mgr.address)
     return ManagerHandle(mgr, mgr.address, authkey)
+
+
+def _die_with_parent():
+    """Manager-server initializer: die when the owning executor dies.
+
+    A SIGKILLed executor cannot shut its manager down, and the orphan is
+    worse than a leak: it inherits the executor's pipe/resource-tracker fds,
+    so the driver's exit blocks forever in the tracker join (observed:
+    vanished-executor shutdown hang).  Linux parent-death-signal closes the
+    hole; elsewhere this is a no-op (orphans persist until cluster teardown
+    kills them explicitly)."""
+    try:
+        import ctypes
+        import signal as _signal
+
+        PR_SET_PDEATHSIG = 1
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(PR_SET_PDEATHSIG, _signal.SIGKILL, 0, 0, 0)
+    except Exception:  # non-Linux / restricted: best-effort only
+        pass
 
 
 def connect(address, authkey):

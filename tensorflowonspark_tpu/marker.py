@@ -84,40 +84,16 @@ def pack_columnar(block):
     the rows aren't uniformly shaped numeric fields (caller falls back to a
     plain object :class:`Chunk`).
 
-    **Tuples** are rows-of-fields (each field an ndarray or scalar with a
-    consistent shape/dtype across the block); anything else (list, ndarray,
-    scalar) is a single data value — a ``[1.0, 2.0]`` list row is a length-2
-    vector, not two fields (matching ``DataFeed.next_batch_arrays``'s
-    historical ``np.asarray(items)`` contract).
-
-    CONTRACT MIRRORS: ``datafeed._rows_to_fields`` (consumer-side degraded
-    path; hard-fails instead of falling back) and ``data.FileFeed._columnar``
-    (FILES path; adds dict rows + dtype casts) implement the same
-    tuple-vs-single-value row semantics — a change to the row contract must
-    update all three.
+    Row semantics live in :mod:`~tensorflowonspark_tpu.columnar` (the one
+    shared contract for this packer, the DataFeed degraded path, and
+    FileFeed); this is the soft (``strict=False``) caller.
     """
-    import numpy as np
+    from tensorflowonspark_tpu import columnar
 
     if not block:
         return None
-    first = block[0]
-    try:
-        if isinstance(first, tuple):
-            arity = len(first)
-            if arity == 0 or any(not isinstance(r, tuple)
-                                 or len(r) != arity for r in block):
-                return None
-            cols = []
-            for f in range(arity):
-                col = np.asarray([row[f] for row in block])
-                if col.dtype == object:
-                    return None
-                cols.append(col)
-            return ColChunk(tuple(cols), len(block), True)
-        col = np.asarray(block)
-        if col.dtype == object:
-            return None
-        return ColChunk((col,), len(block), False)
-    except (ValueError, TypeError):
-        # ragged shapes / mixed types: not columnar-packable
+    res = columnar.rows_to_fields(block, strict=False)
+    if res is None:
         return None
+    fields, tuple_rows = res
+    return ColChunk(fields, len(block), tuple_rows)

@@ -93,6 +93,12 @@ class TimeHistory(object):
         self.train_start_time = None
         self.start_time = None
         self.elapsed = 0.0
+        # per-step loss vectors from K-step scan groups, buffered as DEVICE
+        # arrays (reading them eagerly would sync every group and defeat
+        # the async pipeline); drained into the summary writer at window
+        # boundaries, where a sync happens anyway
+        self._pending_losses = []
+        self._loss_curve_end = 0  # last step the per-step curve has covered
 
     def on_train_begin(self):
         self.train_start_time = time.time()
@@ -118,11 +124,20 @@ class TimeHistory(object):
         ``lax.scan`` group ran K steps on device, see ``Trainer.multi_step``).
         A window closes whenever the step counter crosses a ``log_steps``
         boundary; window length in steps is tracked exactly, so throughput
-        stays honest even when boundaries land mid-group."""
+        stays honest even when boundaries land mid-group.
+
+        ``value`` may be a length-``n`` PER-STEP loss vector (the scan's
+        stacked ys): the TensorBoard loss curve then keeps full per-step
+        density under K-steps-per-dispatch — points buffer as device arrays
+        and flush at window boundaries, so no extra syncs enter the
+        pipeline."""
         if self.train_start_time is None:
             self.on_train_begin()
         before = self.global_steps
         self.global_steps += n
+        vec = value if getattr(value, "ndim", 0) else None
+        if vec is not None and self.summary_writer is not None:
+            self._pending_losses.append((before, vec))
         if self.global_steps // self.log_steps > before // self.log_steps:
             self._sync(value)
             now = time.time()
@@ -138,11 +153,14 @@ class TimeHistory(object):
                 msg += ", %.1f%% MFU" % (100 * mfu)
             logger.info(msg)
             if self.summary_writer is not None:
+                # drain buffered per-step loss vectors first (their steps
+                # completed long ago: device_get here stalls nothing)
+                flushed_loss = self._drain_pending_losses()
                 scalars = {"examples_per_sec": eps,
                            "ms_per_step": 1000 * elapsed / window_steps}
                 if mfu is not None:
                     scalars["mfu"] = mfu
-                if value is not None:
+                if value is not None and not flushed_loss:
                     try:
                         scalars["loss"] = float(value)
                     except TypeError:
@@ -154,9 +172,29 @@ class TimeHistory(object):
             self.timestamp_log.append((self.global_steps, now))
             self.start_time = now
 
+    def _drain_pending_losses(self):
+        """Write buffered per-step loss vectors to the summary writer;
+        returns True if any point was written."""
+        import jax
+        import numpy as np
+
+        for s0, v in self._pending_losses:
+            arr = np.asarray(jax.device_get(v))
+            for i, l in enumerate(arr):
+                self.summary_writer.add_scalars({"loss": float(l)}, s0 + i + 1)
+            self._loss_curve_end = max(self._loss_curve_end, s0 + len(arr))
+        drained = bool(self._pending_losses)
+        self._pending_losses = []
+        return drained
+
     def on_train_end(self, value=None):
         self._sync(value)
         self.elapsed = time.time() - self.train_start_time
+        if self.summary_writer is not None and self._pending_losses:
+            # flush the tail of the per-step loss curve (steps since the
+            # last window boundary)
+            self._drain_pending_losses()
+            self.summary_writer.flush()
 
     def mfu(self, step_seconds):
         # step_flops and peak are both per-device figures (XLA cost analysis
@@ -208,9 +246,11 @@ class TimeHistory(object):
         stats = self.build_stats(**kwargs)
         logger.info("train stats: %s", json.dumps(stats, default=float))
         if self.summary_writer is not None:
-            final = {k: float(stats[k]) for k in
-                     ("loss", "avg_exp_per_second", "avg_step_seconds",
-                      "mfu", "eval_loss", "accuracy_top_1") if k in stats}
+            keys = ["loss", "avg_exp_per_second", "avg_step_seconds",
+                    "mfu", "eval_loss", "accuracy_top_1"]
+            if self._loss_curve_end >= self.global_steps:
+                keys.remove("loss")  # per-step curve already has this point
+            final = {k: float(stats[k]) for k in keys if k in stats}
             self.summary_writer.add_scalars(final, self.global_steps)
             self.summary_writer.flush()
         return stats

@@ -217,7 +217,7 @@ class Trainer(object):
                     new_st, loss, _ = self._step_core(st, b, m)
                     return new_st, loss
                 state, losses = jax.lax.scan(body, state, (batches, masks))
-                return state, losses[-1]
+                return state, losses  # per-step: keeps the loss curve dense
             self._multi_cache[k] = jax.jit(
                 multi, donate_argnums=self._donate)
         return self._multi_cache[k]
@@ -234,7 +234,7 @@ class Trainer(object):
                     new_st, loss, _ = self._step_core(st, batch, mask)
                     return new_st, loss
                 state, losses = jax.lax.scan(body, state, None, length=k)
-                return state, losses[-1]
+                return state, losses  # per-step: keeps the loss curve dense
             self._multi_cache[key] = jax.jit(
                 repeat, donate_argnums=self._donate)
         return self._multi_cache[key]
@@ -274,26 +274,32 @@ class Trainer(object):
 
     def repeat_step(self, batch, mask, k):
         """Run ``k`` steps on one batch in a single dispatch; returns the
-        final step's loss."""
+        final step's loss.  The full per-step loss vector (the scan's ys)
+        goes to the metrics recorder, so the TensorBoard curve keeps
+        per-step density."""
         fn = self._get_repeat_step(k)
         self._ensure_history(batch, mask)
-        self.state, loss = fn(self.state, batch, mask)
-        self.history.on_steps_end(k, loss)
-        return loss
+        self.state, losses = fn(self.state, batch, mask)
+        self.history.on_steps_end(k, losses)
+        # losses is replicated (fully addressable on every host): eager
+        # indexing is safe even on a multi-host mesh
+        return losses[-1]
 
     def multi_step(self, batches, masks):
         """Run K steps in one dispatch; ``batches``/``masks`` leaves carry a
         leading scan dim K (see :func:`~...parallel.mesh.scan_batch_sharding`
         and :meth:`~...parallel.infeed.ShardedFeed.grouped_batches`).
-        Returns the final step's loss."""
+        Returns the final step's loss; the per-step loss vector feeds the
+        metrics recorder (dense TensorBoard curve under K-steps-per-
+        dispatch)."""
         k = int(jax.tree_util.tree_leaves(masks)[0].shape[0])
         fn = self._get_multi_step(k)
         self._ensure_history(batches, masks, stacked=True)
-        self.state, loss = fn(self.state, batches, masks)
-        self.history.on_steps_end(k, loss)
-        return loss
+        self.state, losses = fn(self.state, batches, masks)
+        self.history.on_steps_end(k, losses)
+        return losses[-1]
 
-    def evaluate(self, sharded_feed, metric_fn):
+    def evaluate(self, sharded_feed, metric_fn, cache_key=None):
         """Exact evaluation over a feed: iterates
         ``sharded_feed.batches(drain="all")`` (every host's rows count —
         exhausted hosts step zero-mask dummies) and accumulates
@@ -310,17 +316,20 @@ class Trainer(object):
         totals (replicated), so host-side accumulation needs no extra
         collective.
 
-        The jit wrapper is cached on the metric fn's identity: for periodic
-        validation, pass the SAME function object every call (define it
+        The jit wrapper is cached on ``cache_key`` when given (pass a
+        stable name like ``"top1"`` and fresh closures are fine — each call
+        reuses the first compilation), else on the metric fn's identity —
+        in that case pass the SAME function object every call (define it
         once, not as a fresh closure per evaluation) or each call retraces
         and the cache grows."""
-        if metric_fn not in self._eval_cache:
+        key = cache_key if cache_key is not None else metric_fn
+        if key not in self._eval_cache:
             if len(self._eval_cache) >= 8:
                 # runaway guard: fresh-closure callers would otherwise pin
                 # one compiled executable per evaluation forever
                 self._eval_cache.clear()
-            self._eval_cache[metric_fn] = jax.jit(metric_fn)
-        fn = self._eval_cache[metric_fn]
+            self._eval_cache[key] = jax.jit(metric_fn)
+        fn = self._eval_cache[key]
         if self._has_extra:
             call = lambda b, m: fn(self.state.params, self.state.extra, b, m)
         else:

@@ -247,12 +247,69 @@ def scenario_checkpoint(rank, world, tmpdir):
     print("checkpoint ok", rank)
 
 
+
+
+def scenario_storm(rank, world, tmpdir):
+    """The flaky-feed storm (VERDICT r3 weak #2): every degrade-adjacent
+    mechanism at once — grouped_batches K-group consensus degrade, prefetch
+    double-buffering, the native shm-ring transport, and an EARLY
+    ``terminate()`` while other hosts still hold queued rows — on an
+    uneven world (run with world=3)."""
+    import pickle
+    import threading
+
+    from tensorflowonspark_tpu import manager, marker, shmring
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    from tensorflowonspark_tpu.parallel.infeed import ShardedFeed
+
+    assert shmring.available(), "shm ring must be the transport under test"
+    mesh = mesh_mod.build_mesh()
+    global_batch = 8 * world
+    # rank 0: 3 local batches (1 full K=2 group, then a flushed single ->
+    # every host degrades in lock-step); others: 10 batches (7+ still
+    # unconsumed at terminate time, some of them sitting in the ring).
+    n_batches = 3 if rank == 0 else 10
+    mgr = manager.start(b"mp-storm-%d" % rank, ["input"])
+    q = mgr.get_queue("input")
+    ring = shmring.Ring.create_or_attach("mpstorm{}".format(rank))
+
+    def feeder():
+        for b in range(n_batches):
+            rows = [[float(rank * 10000 + b * 8 + i)] for i in range(8)]
+            chunk = marker.pack_columnar(rows)
+            assert chunk is not None
+            data = pickle.dumps(chunk, protocol=pickle.HIGHEST_PROTOCOL)
+            assert ring.put_bytes(data, timeout_secs=120)
+            q.put(marker.ShmChunk(ring.name, 8), block=True)
+        q.put(None)
+
+    t = threading.Thread(target=feeder, daemon=True)
+    t.start()
+
+    sf = ShardedFeed(DataFeed(mgr), mesh, global_batch, prefetch=2)
+    kinds = []
+    for kind, batch, mask in sf.grouped_batches(2):
+        kinds.append(kind)
+        if kind == "single":
+            break  # stop mid-stream: long ranks still have rows queued
+    # single-consumer discipline: terminate joins the prefetch thread then
+    # drains the queue AND the ring so the feeder can finish its puts
+    sf.terminate()
+    t.join(timeout=120)
+    assert not t.is_alive(), "feeder wedged: terminate failed to drain"
+    mgr.shutdown()
+    assert kinds == ["multi", "single"], (rank, kinds)
+    print("storm ok", rank, kinds)
+
+
 SCENARIOS = {
     "consensus": scenario_consensus,
     "infeed": scenario_infeed,
     "grouped": scenario_grouped,
     "drain": scenario_drain_all,
     "filefeed": scenario_filefeed,
+    "storm": scenario_storm,
     "checkpoint": scenario_checkpoint,
 }
 

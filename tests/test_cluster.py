@@ -396,3 +396,111 @@ def test_hard_killed_consumer_surfaces_feed_timeout(local_backend, tmp_path):
         c.train(backend.partition(range(100), 2), feed_timeout=8)
     with pytest.raises(SystemExit):
         c.shutdown(grace_secs=1)
+
+
+class _ShutdownFakes:
+    """Minimal backend/server/job doubles for driving TPUCluster.shutdown
+    coverage logic without a live cluster."""
+
+    class Backend:
+        def __init__(self, reached):
+            self.reached = reached  # executor ids the poison tasks "reach"
+            self.stopped = False
+
+        def map_partitions(self, parts, fn, timeout=None):
+            return [[i] if i in self.reached else [] for (i,) in parts]
+
+        def stop(self):
+            self.stopped = True
+
+    class Server:
+        done = False
+
+        def stop(self):
+            pass
+
+    class Job:
+        error = None
+
+        def done(self):
+            return True
+
+        def wait(self, timeout=None):
+            pass
+
+
+def _mk_cluster(reached, worker_states):
+    """Cluster of 2 workers; poison tasks reach `reached`; each worker id
+    maps to a live manager seeded with worker_states[id] (or no manager at
+    all for state None — a vanished executor)."""
+    from tensorflowonspark_tpu import manager as mgr_mod
+
+    info, handles = [], []
+    for i, state in worker_states.items():
+        authkey = b"shutdown-test-%d" % i
+        addr = None
+        if state is not None:
+            h = mgr_mod.start(authkey, ["control"])
+            h.set("state", state)
+            handles.append(h)
+            addr = h.address
+        # host = the driver's own IP: this scenario is genuinely same-host
+        # (LocalBackend), which is what makes a failed unix-socket probe
+        # authoritative evidence of a dead executor
+        from tensorflowonspark_tpu import util as util_mod
+
+        info.append({"executor_id": i, "job_name": "worker", "task_index": i,
+                     "host": util_mod.get_ip_address(),
+                     "addr": addr or "/tmp/gone-%d" % i,
+                     "authkey": authkey.hex()})
+    c = cluster.TPUCluster(
+        _ShutdownFakes.Backend(reached), {"id": "t", "spark_mode": False},
+        info, cluster.InputMode.SPARK, _ShutdownFakes.Server(),
+        _ShutdownFakes.Job(), {}, ["input", "output"])
+    return c, handles
+
+
+def test_shutdown_unconfirmed_but_finished_is_clean():
+    """Poison tasks never reach node 1, but its manager reports finished:
+    shutdown must complete with exit 0 (no SystemExit)."""
+    c, handles = _mk_cluster(reached={0},
+                             worker_states={0: "running", 1: "finished"})
+    try:
+        c.shutdown(grace_secs=1, timeout=60)  # must not raise
+    finally:
+        for h in handles:
+            h.shutdown()
+
+
+def test_shutdown_vanished_executor_exits_nonzero():
+    """A worker that never confirms poisoning AND has no reachable manager
+    (executor died) must fail the driver with exit status 1 (reference
+    TFCluster.py:177-181), not a warning + exit 0."""
+    c, handles = _mk_cluster(reached={0},
+                             worker_states={0: "running", 1: None})
+    try:
+        with pytest.raises(SystemExit) as exc:
+            c.shutdown(grace_secs=1, timeout=60)
+        assert exc.value.code == 1
+        assert "never confirmed" in c.tf_status["error"]
+    finally:
+        for h in handles:
+            h.shutdown()
+
+
+def test_shutdown_remote_unreachable_is_warning_not_fatal():
+    """From a REMOTE driver, a worker's unix-socket manager is unreachable
+    by design (node.py mode='local') — an unconfirmed remote node must stay
+    the historical loud warning, not exit 1 on a healthy job."""
+    c, handles = _mk_cluster(reached={0},
+                             worker_states={0: "running", 1: None})
+    # make node 1 look like it lives on another host
+    for n in c.cluster_info:
+        if n["executor_id"] == 1:
+            n["host"] = "203.0.113.77"
+    try:
+        c.shutdown(grace_secs=1, timeout=60)  # must not raise
+        assert "error" not in c.tf_status
+    finally:
+        for h in handles:
+            h.shutdown()

@@ -35,7 +35,8 @@ def main_fun(args, ctx):
 
     ctx.initialize_distributed()
     mesh = mesh_mod.build_mesh(
-        mesh_mod.MeshSpec(data=args.data, seq=args.seq, tensor=args.tensor),
+        mesh_mod.MeshSpec(data=args.data, fsdp=args.fsdp, seq=args.seq,
+                          tensor=args.tensor),
         keep_trivial_axes=True)
 
     model = tfm.build_transformer(
@@ -59,11 +60,20 @@ def main_fun(args, ctx):
     optimizer = optax.adamw(args.lr)
     loss = tfm.loss_fn(model)
 
-    batch_sharding = NamedSharding(mesh, PartitionSpec("data", "seq"))
-    mask_sharding = NamedSharding(mesh, PartitionSpec("data"))
-    params = jax.device_put(params, mesh_mod.replicated(mesh))
-    opt_state = jax.device_put(optimizer.init(params),
-                               mesh_mod.replicated(mesh))
+    # batch: dp (data AND fsdp axes) x sp; params/opt state: replicated, or
+    # fsdp-sharded when the fsdp axis is real (parallel/fsdp.py)
+    batch_axes = (("data", "fsdp") if args.fsdp > 1 else "data")
+    batch_sharding = NamedSharding(mesh, PartitionSpec(batch_axes, "seq"))
+    mask_sharding = NamedSharding(mesh, PartitionSpec(batch_axes))
+    if args.fsdp > 1:
+        from tensorflowonspark_tpu.parallel import fsdp as fsdp_mod
+
+        params = fsdp_mod.shard_tree(params, mesh)
+        opt_state = fsdp_mod.shard_tree(optimizer.init(params), mesh)
+    else:
+        params = jax.device_put(params, mesh_mod.replicated(mesh))
+        opt_state = jax.device_put(optimizer.init(params),
+                                   mesh_mod.replicated(mesh))
 
     def train_step(params, opt_state, tokens, mask):
         (l, _), grads = jax.value_and_grad(loss, has_aux=True)(
@@ -141,8 +151,11 @@ def main_fun(args, ctx):
     stats = history.log_stats(loss=lval)
 
     if args.export_dir and checkpoint.should_export(ctx):
+        # pass device params as-is: export_model re-replicates
+        # cross-process-sharded (fsdp) trees itself; an eager device_get
+        # here would raise on not-fully-addressable arrays
         checkpoint.export_model(
-            ctx.absolute_path(args.export_dir), jax.device_get(params),
+            ctx.absolute_path(args.export_dir), params,
             "transformer_lm",
             model_config={"vocab_size": args.vocab_size,
                           "num_layers": args.num_layers,
@@ -167,6 +180,9 @@ def main(argv=None):
     parser.add_argument("--num_heads", type=int, default=8)
     parser.add_argument("--head_dim", type=int, default=32)
     parser.add_argument("--seq_len", type=int, default=1024)
+    parser.add_argument("--fsdp", type=int, default=1,
+                        help="fsdp-axis size: shards params + optimizer "
+                        "state (and contributes to batch parallelism)")
     parser.add_argument("--data", type=int, default=2,
                         help="data-parallel mesh degree")
     parser.add_argument("--seq", type=int, default=2,

@@ -217,6 +217,21 @@ def export_model(export_dir, params, model_name, model_config=None,
     import jax
     import orbax.checkpoint as ocp
 
+    # Cross-process-sharded params (e.g. Trainer(param_sharding="fsdp") on
+    # a multi-host mesh) are not fully addressable: device_get below would
+    # raise after a full training run.  Re-replicate through a jit identity
+    # (SPMD all-gather) first; fully-addressable trees pass through as-is.
+    leaves = [l for l in jax.tree_util.tree_leaves(params)
+              if isinstance(l, jax.Array)]
+    if any(not l.is_fully_addressable for l in leaves):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = next(l.sharding.mesh for l in leaves
+                    if not l.is_fully_addressable)
+        params = jax.jit(
+            lambda p: p,
+            out_shardings=NamedSharding(mesh, PartitionSpec()))(params)
+
     export_dir = _fs_path(export_dir)
     os.makedirs(export_dir, exist_ok=True)
     ckptr = ocp.StandardCheckpointer()

@@ -1,0 +1,100 @@
+"""FSDP: parameter + optimizer-state sharding over the ``fsdp`` mesh axis.
+
+The ``fsdp`` axis has always contributed to BATCH sharding
+(:func:`~tensorflowonspark_tpu.parallel.mesh.batch_sharding` treats it as
+data-like); this module adds the other half — sharding the MODEL state
+over it, so per-device parameter/optimizer memory drops by the axis size.
+The reference has no equivalent (its scaling story stops at sync data
+parallel, SURVEY §2.4); this is TPU-native headroom for models whose
+optimizer state outgrows one chip.
+
+The JAX/GSPMD recipe (the "How to Scale Your Model" FSDP chapter): give
+every parameter a :class:`NamedSharding` that splits ONE dimension over
+``fsdp``, keep everything else replicated, and let XLA insert the
+all-gathers (weights, before use) and reduce-scatters (grads, after the
+backward) on ICI.  No hand-written collectives; the train step is the
+same SPMD program.
+
+Rule: each leaf shards its LARGEST dimension divisible by the axis size;
+leaves smaller than ``min_size`` elements (biases, norm scales, scalars)
+replicate — sharding them buys nothing and costs collective latency.
+Optimizer state (momentum etc.) mirrors parameter shapes leaf-by-leaf, so
+the same shape-driven rule applies verbatim.
+"""
+
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_MIN_SIZE = 2 ** 14  # leaves below 16k elements stay replicated
+
+
+def leaf_spec(shape, axis_size, axis="fsdp", min_size=DEFAULT_MIN_SIZE):
+    """PartitionSpec for one array shape: largest dim divisible by
+    ``axis_size`` shards over ``axis``; too-small/indivisible replicate.
+
+    The divisibility/tie-breaking rule is ``tp._heuristic_dim`` — ONE
+    implementation for both strategies (TP skips rank-1 leaves; FSDP
+    shards them and adds the ``min_size`` replicate threshold)."""
+    from jax.sharding import PartitionSpec
+
+    from tensorflowonspark_tpu.parallel.tp import _heuristic_dim
+
+    if axis_size <= 1 or int(np.prod(shape or (1,))) < min_size:
+        return PartitionSpec()
+    d = _heuristic_dim(shape, axis_size, allow_1d=True)
+    if d is None:
+        return PartitionSpec()
+    spec = [None] * len(shape)
+    spec[d] = axis
+    return PartitionSpec(*spec)
+
+
+def tree_shardings(tree, mesh, axis="fsdp", min_size=DEFAULT_MIN_SIZE):
+    """Matching pytree of NamedShardings for ``tree`` under the FSDP rule.
+
+    Works on params, optimizer state, or a whole
+    :class:`~tensorflowonspark_tpu.train.TrainState` (leaves are judged by
+    shape alone, so mirrored-momentum leaves shard exactly like their
+    parameters and scalars like ``step`` replicate).
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    if axis not in mesh.axis_names:
+        raise ValueError("mesh has no {!r} axis (axes: {})".format(
+            axis, mesh.axis_names))
+    n = mesh.shape[axis]
+
+    def one(x):
+        shape = tuple(getattr(x, "shape", ()))
+        return NamedSharding(mesh, leaf_spec(shape, n, axis, min_size))
+
+    return jax.tree_util.tree_map(one, tree)
+
+
+def shard_tree(tree, mesh, axis="fsdp", min_size=DEFAULT_MIN_SIZE):
+    """``device_put`` ``tree`` with FSDP shardings; returns the sharded
+    pytree.  Logs the per-device memory ratio actually achieved."""
+    import jax
+
+    sh = tree_shardings(tree, mesh, axis, min_size)
+    out = jax.device_put(tree, sh)
+    total = sum(int(np.prod(l.shape or (1,)))
+                for l in jax.tree_util.tree_leaves(out))
+    sharded = sum(
+        int(np.prod(l.shape or (1,)))
+        for l, s in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(
+                            sh, is_leaf=lambda x: hasattr(x, "spec")))
+        if any(s.spec))
+    if total:
+        n = mesh.shape[axis]
+        logger.info(
+            "fsdp(x%d): %.1f%% of %d state elements sharded "
+            "(per-device state ~%.2fx of replicated)", n,
+            100.0 * sharded / total, total,
+            (total - sharded + sharded / n) / total)
+    return out

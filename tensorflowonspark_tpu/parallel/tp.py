@@ -38,10 +38,14 @@ def _param_path(path):
     return "/".join(parts)
 
 
-def _heuristic_dim(shape, tp):
+def _heuristic_dim(shape, tp, allow_1d=False):
     """Largest tp-divisible dim, preferring the trailing (output-features)
-    dim on ties — Megatron column-parallel for the big projections."""
-    if len(shape) < 2:
+    dim on ties — Megatron column-parallel for the big projections.
+
+    ``allow_1d``: also shard rank-1 leaves (the FSDP rule wants this for
+    large vectors; TP skips them — shared by ``fsdp.leaf_spec`` so the two
+    strategies can't drift on divisibility/tie-breaking)."""
+    if len(shape) < (1 if allow_1d else 2):
         return None
     dims = sorted(range(len(shape)),
                   key=lambda d: (shape[d], d), reverse=True)

@@ -64,6 +64,12 @@ class Trainer(object):
         params/optimizer state stay fp32).
       batch_size: global batch size (for throughput metrics).
       log_steps: TimeHistory window.
+      param_sharding: ``None`` replicates params/optimizer state over the
+        mesh (reference-parity data parallel); ``"fsdp"`` shards them over
+        the mesh's ``fsdp`` axis (per-device state memory divided by the
+        axis size; XLA inserts the weight all-gathers and grad
+        reduce-scatters — see :mod:`~tensorflowonspark_tpu.parallel.fsdp`);
+        or an explicit pytree of shardings matching the TrainState.
       accum_steps: gradient accumulation — split each batch into this many
         sequential microbatch grad passes (lax.scan) with one optimizer
         update; peak activation memory drops by ~accum_steps and the batch
@@ -82,7 +88,7 @@ class Trainer(object):
     def __init__(self, loss_fn, init_params, optimizer, mesh=None,
                  extra_state=None, compute_dtype=None, batch_size=None,
                  log_steps=20, donate=True, accum_steps=1,
-                 summary_writer=None):
+                 summary_writer=None, param_sharding=None):
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh()
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -95,14 +101,27 @@ class Trainer(object):
         self.summary_writer = summary_writer
         self._has_extra = extra_state is not None
 
-        replicated = mesh_mod.replicated(self.mesh)
         self.state = TrainState(
             step=jnp.zeros((), jnp.int32),
             params=init_params,
             opt_state=optimizer.init(init_params),
             extra=extra_state,
         )
-        self.state = jax.device_put(self.state, replicated)
+        if param_sharding == "fsdp":
+            # FSDP: params + optimizer state shard over the mesh's "fsdp"
+            # axis (per-device state memory / axis size); XLA inserts the
+            # weight all-gathers and grad reduce-scatters.  Elementwise
+            # optimizer updates preserve the sharding, so the state stays
+            # sharded across steps with no re-annotation.
+            from tensorflowonspark_tpu.parallel import fsdp as fsdp_mod
+
+            self.state = fsdp_mod.shard_tree(self.state, self.mesh)
+        elif param_sharding is not None:
+            # explicit pytree of shardings matching the TrainState
+            self.state = jax.device_put(self.state, param_sharding)
+        else:
+            self.state = jax.device_put(self.state,
+                                        mesh_mod.replicated(self.mesh))
         # Own our buffers: device_put is a no-op for already-resident arrays,
         # and the donated step would then delete buffers the caller (or a
         # sibling Trainer built from the same init_params) still holds.

@@ -321,3 +321,107 @@ class TestFSDP:
             np.testing.assert_allclose(np.asarray(fsdp_p[k]),
                                        np.asarray(base_p[k]),
                                        rtol=1e-5, atol=1e-5)
+
+
+class TestFSDPStateSharding:
+    """Parameter/optimizer sharding over the fsdp axis (parallel/fsdp.py);
+    the axis-generic tp-API variant lives in TestFSDP above."""
+
+    def test_leaf_spec_rule(self):
+        from jax.sharding import PartitionSpec as P
+
+        from tensorflowonspark_tpu.parallel import fsdp
+
+        # large 2D: largest divisible dim shards
+        assert fsdp.leaf_spec((512, 128), 4, min_size=0) == P("fsdp", None)
+        assert fsdp.leaf_spec((128, 512), 4, min_size=0) == P(None, "fsdp")
+        # largest dim indivisible -> next largest divisible
+        assert fsdp.leaf_spec((513, 128), 4, min_size=0) == P(None, "fsdp")
+        # nothing divisible -> replicate
+        assert fsdp.leaf_spec((513, 127), 4, min_size=0) == P()
+        # small leaves replicate
+        assert fsdp.leaf_spec((64,), 4, min_size=2 ** 14) == P()
+        # scalars replicate
+        assert fsdp.leaf_spec((), 4, min_size=0) == P()
+
+    def test_state_shards_and_memory_drops(self):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from tensorflowonspark_tpu.parallel import fsdp
+        from tensorflowonspark_tpu.train import Trainer
+
+        mesh = build_mesh({"data": 2, "fsdp": 4})
+
+        def loss(params, batch, mask):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2 * mask[:, None]), {}
+
+        params = {"w": jnp.zeros((256, 128)), "b": jnp.zeros((128,))}
+        tr = Trainer(loss, params, optax.adam(1e-2), mesh=mesh,
+                     batch_size=16, param_sharding="fsdp")
+        # the big kernel shards over fsdp; adam's mirrored moments follow
+        w_shard = tr.state.params["w"].sharding
+        assert "fsdp" in (w_shard.spec[0], w_shard.spec[1] if
+                          len(w_shard.spec) > 1 else None)
+        mu_w = jax.tree_util.tree_leaves(
+            tr.state.opt_state, is_leaf=lambda x: hasattr(x, "sharding"))
+        assert any("fsdp" in str(getattr(l, "sharding", ""))
+                   for l in mu_w), "optimizer moments not sharded"
+        # the small bias and the step counter replicate
+        assert tr.state.params["b"].sharding.spec == ()
+        assert tr.state.step.sharding.spec == ()
+
+    def test_fsdp_matches_replicated_training(self):
+        """FSDP is a MEMORY layout, not different math: K steps under
+        {data:2, fsdp:4} must match pure replicated {data:8} exactly."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+        from tensorflowonspark_tpu.train import Trainer
+
+        def loss(params, batch, mask):
+            h = jnp.tanh(batch["x"] @ params["w1"])
+            pred = h @ params["w2"]
+            err = ((pred - batch["y"]) ** 2).mean(-1) * mask
+            return err.sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+        rng = np.random.default_rng(0)
+        params = {"w1": jnp.asarray(rng.normal(0, 0.1, (64, 128)),
+                                    jnp.float32),
+                  "w2": jnp.asarray(rng.normal(0, 0.1, (128, 32)),
+                                    jnp.float32)}
+
+        def run(mesh, param_sharding):
+            tr = Trainer(loss, params, optax.adam(1e-2), mesh=mesh,
+                         batch_size=16, param_sharding=param_sharding)
+            shard = mesh_mod.batch_sharding(mesh)
+            losses = []
+            for s in range(4):
+                b = {"x": jax.device_put(
+                        np.asarray(rng2.normal(0, 1, (16, 64)), np.float32),
+                        shard),
+                     "y": jax.device_put(
+                        np.asarray(rng2.normal(0, 1, (16, 32)), np.float32),
+                        shard)}
+                l, _ = tr.step(b)
+                losses.append(float(l))
+            return losses, jax.device_get(
+                jax.jit(lambda p: p,
+                        out_shardings=mesh_mod.replicated(mesh))(
+                            tr.state.params))
+
+        rng2 = np.random.default_rng(7)
+        l_rep, p_rep = run(build_mesh({"data": 8}), None)
+        rng2 = np.random.default_rng(7)
+        l_fsdp, p_fsdp = run(build_mesh({"data": 2, "fsdp": 4}), "fsdp")
+
+        np.testing.assert_allclose(l_rep, l_fsdp, rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5,
+                                                    atol=1e-6),
+            p_rep, p_fsdp)

@@ -10,17 +10,28 @@ can see the chip it runs, in order:
 2. ``scripts/device_validate.py`` (pin_chips + profiler-trace evidence)
    -> ``.bench_watch/device_validate.json``
 
-and exits 0.  If the bench ran but produced no device numbers (tunnel
-flapped mid-leg), it keeps watching and retries the device legs on the next
-probe success.  Exits 3 when the deadline passes with no device numbers.
+Evidence is persisted from the FIRST probe, not just on success — a round
+where the tunnel never appears must still be distinguishable from a round
+where the watcher never ran:
+
+- ``.bench_watch/probes.jsonl``: one JSON line per probe attempt
+  ``{"ts", "utc", "up", "device_kind", "elapsed_s", "error"}``
+- ``.bench_watch/watch.log``: the watcher's own log (also on stdout)
+- ``.bench_watch/watch.pid``: pid of the live watcher (removed on exit)
+
+If the bench ran but produced no device numbers (tunnel flapped mid-leg),
+it keeps watching and retries the device legs on the next probe success.
+Exits 3 when the deadline passes with no device numbers.
 
 Run it in the background at round start:
     python scripts/bench_watch.py --hours 11 &
 """
 
 import argparse
+import atexit
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -29,21 +40,49 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT_DIR = os.path.join(ROOT, ".bench_watch")
 PROBE_CODE = "import jax; print(jax.devices()[0].device_kind)"
 
+_LOG_FH = None
+
 
 def log(msg):
-    print("[bench_watch %s] %s" % (time.strftime("%H:%M:%S"), msg),
-          flush=True)
+    line = "[bench_watch %s] %s" % (time.strftime("%H:%M:%S"), msg)
+    print(line, flush=True)
+    if _LOG_FH is not None:
+        _LOG_FH.write(line + "\n")
+        _LOG_FH.flush()
+
+
+def record_probe(up, device_kind, elapsed, error):
+    entry = {
+        "ts": time.time(),
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "up": up,
+        "device_kind": device_kind,
+        "elapsed_s": round(elapsed, 1),
+        "error": error,
+    }
+    with open(os.path.join(OUT_DIR, "probes.jsonl"), "a") as f:
+        f.write(json.dumps(entry) + "\n")
 
 
 def probe(timeout=120):
+    """Returns (device_kind_or_None, error_or_None); always records a line."""
+    t0 = time.time()
     try:
         proc = subprocess.run([sys.executable, "-c", PROBE_CODE],
                               timeout=timeout, capture_output=True, text=True)
     except subprocess.TimeoutExpired:
-        return None
+        record_probe(False, None, time.time() - t0,
+                     "probe timed out after %ds" % timeout)
+        return None, "timeout"
+    elapsed = time.time() - t0
     if proc.returncode == 0 and proc.stdout.strip():
-        return proc.stdout.strip().splitlines()[-1]
-    return None
+        kind = proc.stdout.strip().splitlines()[-1]
+        record_probe(True, kind, elapsed, None)
+        return kind, None
+    err = (proc.stderr or "").strip().splitlines()
+    err = err[-1][:200] if err else "rc=%d, no output" % proc.returncode
+    record_probe(False, None, elapsed, err)
+    return None, err
 
 
 def run_bench():
@@ -89,18 +128,31 @@ def run_validate():
 
 
 def main():
+    global _LOG_FH
     ap = argparse.ArgumentParser()
     ap.add_argument("--hours", type=float, default=11.0)
     ap.add_argument("--interval", type=float, default=150.0,
                     help="seconds between probes while the tunnel is down")
     args = ap.parse_args()
     os.makedirs(OUT_DIR, exist_ok=True)
+    _LOG_FH = open(os.path.join(OUT_DIR, "watch.log"), "a")
+
+    pidfile = os.path.join(OUT_DIR, "watch.pid")
+    with open(pidfile, "w") as f:
+        f.write(str(os.getpid()))
+    atexit.register(lambda: os.path.exists(pidfile) and os.remove(pidfile))
+    # plain `kill` must still remove the pidfile: default SIGTERM handling
+    # skips atexit, leaving a stale pid that reads as a live watcher
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
     deadline = time.time() + args.hours * 3600
+    log("watcher started: pid=%d deadline in %.1fh interval=%ds"
+        % (os.getpid(), args.hours, int(args.interval)))
 
     while time.time() < deadline:
-        kind = probe()
+        kind, err = probe()
         if not kind:
-            log("tunnel down; next probe in %ds" % int(args.interval))
+            log("tunnel down (%s); next probe in %ds" % (err, int(args.interval)))
             time.sleep(args.interval)
             continue
         log("DEVICE UP: %s -- running bench" % kind)

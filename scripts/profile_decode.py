@@ -135,6 +135,7 @@ def leg_pool(shards, total, px, procs):
         num_procs=procs, shard=False)
     t_start = time.perf_counter()
     t0 = None
+    startup = None
     n = 0
     while not feed.should_stop():
         _, count = feed.next_batch_arrays(64)

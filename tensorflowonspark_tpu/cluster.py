@@ -264,6 +264,24 @@ class TPUCluster(object):
                         logger.info("node %d already %s; shutdown coverage "
                                     "not needed", i, state)
                         continue
+                    if state is not None:
+                        # The probe SUCCEEDED and the node is still live:
+                        # a shutdown-coverage gap, not a dead executor —
+                        # don't latch a fatal error.  'terminating' means
+                        # the poison marker WAS seen (the node is draining
+                        # but its result never reached the driver);
+                        # 'running' means the marker never landed.
+                        if state == "terminating":
+                            logger.warning(
+                                "node %d saw the poison marker and is still "
+                                "draining (state=terminating); its shutdown "
+                                "result never reached the driver", i)
+                        else:
+                            logger.warning(
+                                "node %d alive but unresponsive to shutdown "
+                                "(state=%s); its queue never saw a poison "
+                                "marker — check feed partitioning", i, state)
+                        continue
                     # A failed probe is only AUTHORITATIVE when the driver
                     # could have reached the manager at all: worker managers
                     # are same-host unix sockets (node.py mode='local'), so

@@ -45,6 +45,15 @@ jax.tree_util.register_pytree_node(
     TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
 
 
+@jax.jit
+def _acc_add(acc, new):
+    """Jitted pytree add for on-device metric accumulation: keeps
+    :meth:`Trainer.evaluate` sums device-resident between batches (no
+    per-batch host sync) and stays legal on multi-host global arrays,
+    where the eager equivalent raises."""
+    return jax.tree_util.tree_map(jnp.add, acc, new)
+
+
 class Trainer(object):
     """Builds and runs a sharded training step.
 
@@ -236,7 +245,10 @@ class Trainer(object):
                     new_st, loss, _ = self._step_core(st, b, m)
                     return new_st, loss
                 state, losses = jax.lax.scan(body, state, (batches, masks))
-                return state, losses  # per-step: keeps the loss curve dense
+                # final loss extracted INSIDE jit: eager indexing on the
+                # scan output would raise on a multi-host mesh, where jit
+                # outputs are global (not fully addressable) arrays
+                return state, (losses, losses[-1])
             self._multi_cache[k] = jax.jit(
                 multi, donate_argnums=self._donate)
         return self._multi_cache[k]
@@ -253,7 +265,9 @@ class Trainer(object):
                     new_st, loss, _ = self._step_core(st, batch, mask)
                     return new_st, loss
                 state, losses = jax.lax.scan(body, state, None, length=k)
-                return state, losses  # per-step: keeps the loss curve dense
+                # final loss extracted INSIDE jit (multi-host safety; see
+                # _get_multi_step)
+                return state, (losses, losses[-1])
             self._multi_cache[key] = jax.jit(
                 repeat, donate_argnums=self._donate)
         return self._multi_cache[key]
@@ -298,11 +312,9 @@ class Trainer(object):
         per-step density."""
         fn = self._get_repeat_step(k)
         self._ensure_history(batch, mask)
-        self.state, losses = fn(self.state, batch, mask)
+        self.state, (losses, final) = fn(self.state, batch, mask)
         self.history.on_steps_end(k, losses)
-        # losses is replicated (fully addressable on every host): eager
-        # indexing is safe even on a multi-host mesh
-        return losses[-1]
+        return final
 
     def multi_step(self, batches, masks):
         """Run K steps in one dispatch; ``batches``/``masks`` leaves carry a
@@ -314,9 +326,9 @@ class Trainer(object):
         k = int(jax.tree_util.tree_leaves(masks)[0].shape[0])
         fn = self._get_multi_step(k)
         self._ensure_history(batches, masks, stacked=True)
-        self.state, losses = fn(self.state, batches, masks)
+        self.state, (losses, final) = fn(self.state, batches, masks)
         self.history.on_steps_end(k, losses)
-        return losses[-1]
+        return final
 
     def evaluate(self, sharded_feed, metric_fn, cache_key=None):
         """Exact evaluation over a feed: iterates
@@ -353,17 +365,23 @@ class Trainer(object):
             call = lambda b, m: fn(self.state.params, self.state.extra, b, m)
         else:
             call = lambda b, m: fn(self.state.params, b, m)
+        # Accumulate ON DEVICE (jitted tree-add): a per-batch float() would
+        # block the host on every dispatch — lethal on remotely-attached
+        # backends where dispatch RTT dominates — and eager adds on multi-
+        # host jit outputs raise.  One sync at the very end.
         totals = None
-        weight_total = 0.0
+        weight_total = None
         for batch, mask in sharded_feed.batches(drain="all"):
             sums, weight = call(batch, mask)
-            sums = {k: float(v) for k, v in sums.items()}
-            totals = (sums if totals is None else
-                      {k: totals[k] + sums[k] for k in totals})
-            weight_total += float(weight)
+            if totals is None:
+                totals, weight_total = sums, weight
+            else:
+                totals, weight_total = _acc_add((totals, weight_total),
+                                                (sums, weight))
         if totals is None:
             return {}
-        return {k: v / max(weight_total, 1.0) for k, v in totals.items()}
+        weight_total = max(float(weight_total), 1.0)
+        return {k: float(v) / weight_total for k, v in totals.items()}
 
     def compile_and_measure(self, example_batch, example_mask):
         """Lower/compile once and capture per-step FLOPs for MFU reporting."""

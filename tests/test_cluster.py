@@ -488,6 +488,20 @@ def test_shutdown_vanished_executor_exits_nonzero():
             h.shutdown()
 
 
+def test_shutdown_live_running_node_is_unresponsive_not_dead():
+    """A worker whose manager probe SUCCEEDS and reports 'running' is alive
+    — the poison markers just never landed on it.  That must be a warning
+    (shutdown-coverage gap), not the fatal 'executor died' latch."""
+    c, handles = _mk_cluster(reached={0},
+                             worker_states={0: "running", 1: "running"})
+    try:
+        c.shutdown(grace_secs=1, timeout=60)  # must not raise
+        assert "error" not in c.tf_status
+    finally:
+        for h in handles:
+            h.shutdown()
+
+
 def test_shutdown_remote_unreachable_is_warning_not_fatal():
     """From a REMOTE driver, a worker's unix-socket manager is unreachable
     by design (node.py mode='local') — an unconfirmed remote node must stay

@@ -148,8 +148,14 @@ def mnist_main(args, ctx):
     # lax.scan dispatch — the data plane delivers stacked groups and the
     # per-step dispatch/transfer overhead amortizes by K.
     # max_steps is an absolute step-counter target; offset by the warmup
-    # steps so the budget counts real fed batches.
-    budget = int(jax.device_get(trainer.state.step)) + args.max_steps
+    # steps so the budget counts real fed batches.  Round the budget DOWN
+    # to a multiple of K: grouped_batches only flushes tail singles on an
+    # end-of-data signal, and a SPARK-mode feed never sends one (the queue
+    # stays open for more train() calls) — a budget needing a partial final
+    # group therefore blocks forever waiting for batches that never come
+    # (observed on-chip: hung at step 224/234 with all 240k rows consumed).
+    post_steps = (args.max_steps // k) * k if k > 1 else args.max_steps
+    budget = int(jax.device_get(trainer.state.step)) + post_steps
     stats = trainer.fit_feed(sharded, max_steps=budget, steps_per_call=k)
     stats["n_devices"] = len(jax.devices())
     stats["device_kind"] = jax.devices()[0].device_kind
@@ -448,8 +454,11 @@ def main():
         resnet = mnist = None
         resnet_err = mnist_err = probe_err
     else:
-        resnet, resnet_err = run_leg_isolated("resnet")
+        # cheapest-first (VERDICT r4): MNIST compiles in seconds, ResNet's
+        # cold compile takes minutes — a tunnel flap mid-round must keep
+        # whatever legs already finished.
         mnist, mnist_err = run_leg_isolated("mnist")
+        resnet, resnet_err = run_leg_isolated("resnet")
     # device-free legs: run regardless of accelerator health
     feedplane, feedplane_err = run_leg_isolated("feedplane")
     ceiling, ceiling_err = run_leg_isolated("ceiling")

@@ -140,10 +140,24 @@ def main():
     pidfile = os.path.join(OUT_DIR, "watch.pid")
     with open(pidfile, "w") as f:
         f.write(str(os.getpid()))
-    atexit.register(lambda: os.path.exists(pidfile) and os.remove(pidfile))
-    # plain `kill` must still remove the pidfile: default SIGTERM handling
+
+    def _cleanup_pidfile():
+        # Only remove the pidfile if it is still OURS: an older watcher
+        # exiting must not delete a newer watcher's pidfile (that would be
+        # the inverse evidence bug — a live watcher reading as absent).
+        try:
+            with open(pidfile) as f:
+                if f.read().strip() == str(os.getpid()):
+                    os.remove(pidfile)
+        except OSError:
+            pass
+
+    atexit.register(_cleanup_pidfile)
+    # plain `kill` and a dropped terminal (`&`-launched watcher, SSH session
+    # ends -> SIGHUP) must still remove the pidfile: default signal handling
     # skips atexit, leaving a stale pid that reads as a live watcher
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    signal.signal(signal.SIGHUP, lambda *_: sys.exit(129))
 
     deadline = time.time() + args.hours * 3600
     log("watcher started: pid=%d deadline in %.1fh interval=%ds"

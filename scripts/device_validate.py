@@ -13,11 +13,18 @@ raw numbers the MFU gap analysis needs:
 3. A ``jax.profiler`` trace captured through the framework's
    :class:`~tensorflowonspark_tpu.profiler.StepProfiler` path, asserting
    trace files actually land on disk.
-4. Dispatch round-trip time (tiny jitted add, blocked per call) — the
-   per-dispatch tunnel latency that motivated K-steps-per-dispatch.
+4. Dispatch round-trip time (tiny jitted add, host readback per call) —
+   the per-dispatch tunnel latency that motivated K-steps-per-dispatch.
 5. Raw sustained bf16 matmul throughput via ``lax.scan`` (dispatch
    amortized): the *achievable* ceiling for MFU on this link, vs the v5e
    peak of 197 bf16 TFLOP/s.
+
+Timing discipline (both timed probes): every sample ends with a
+device->host READBACK of a value data-dependent on the work, never just
+``block_until_ready`` — on remotely-attached backends block_until_ready
+returns before execution completes (measured: a 4.4-TFLOP scan "finished"
+in 0.1 ms, i.e. 193x the hardware peak), so a readback is the only
+provable barrier (same rule as ``metrics.TimeHistory._sync``).
 
 Writes one JSON blob to --out.  Each probe is isolated in a subprocess so a
 mid-capture tunnel flap loses one number, not all of them.
@@ -76,13 +83,13 @@ print(json.dumps({{"log_dir": log_dir, "n_trace_files": len(files),
 DISPATCH = r"""
 import json, time
 import jax, jax.numpy as jnp
-f = jax.jit(lambda x: x + 1)
+f = jax.jit(lambda x: (x + 1).sum())  # scalar out: readback is 4 bytes
 x = jnp.zeros((8,), jnp.float32)
-f(x).block_until_ready()
+float(f(x))  # warm; float() = device->host readback, the real barrier
 ts = []
 for _ in range(20):
     t0 = time.perf_counter()
-    f(x).block_until_ready()
+    float(f(x))
     ts.append(time.perf_counter() - t0)
 ts.sort()
 print(json.dumps({{"dispatch_rtt_ms_median": round(1e3 * ts[len(ts)//2], 2),
@@ -93,21 +100,25 @@ MATMUL = r"""
 import json, time
 import jax, jax.numpy as jnp
 from jax import lax
-N, K = 4096, 32
+# K=512 amortizes the ~80-100 ms tunnel RTT below 1% of the sample.
+N, K = 4096, 512
 def body(c, _):
     c = jnp.tanh(c @ c)  # tanh breaks trivial fusion/strength-reduction
     return c, ()
 @jax.jit
 def run(x):
     y, _ = lax.scan(body, x, None, length=K)
-    return y
+    return y.sum()  # scalar out: readback (the barrier) is 4 bytes
 x = jnp.ones((N, N), jnp.bfloat16) * 0.001
-run(x).block_until_ready()
-t0 = time.perf_counter()
-run(x).block_until_ready()
-dt = time.perf_counter() - t0
+float(run(x))  # warm + compile; float() forces real completion
+best = None
+for _ in range(3):
+    t0 = time.perf_counter()
+    float(run(x))
+    dt = time.perf_counter() - t0
+    best = dt if best is None else min(best, dt)
 flops = 2 * N * N * N * K
-tflops = flops / dt / 1e12
+tflops = flops / best / 1e12
 print(json.dumps({{"matmul_n": N, "scan_len": K,
                    "sustained_bf16_tflops": round(tflops, 1),
                    "v5e_peak_tflops": 197,

@@ -302,17 +302,36 @@ def feedplane_main(args, ctx):
     # whole batches only: a final partial request would block on a queue
     # whose end sentinel arrives only with the shutdown job
     target = (args.expected_rows // args.batch_size) * args.batch_size
+    # window boundaries for a variance estimate (VERDICT r4 item 8: a bare
+    # mean can't distinguish regression from machine noise) — per-window
+    # rates over ~8 equal row windows plus host load before/after
+    window = max((target // 8) // args.batch_size, 1) * args.batch_size
+    load0 = os.getloadavg()[0]
     t0 = time.time()
     rows = 0
+    marks = []  # (rows, t) at each window boundary
+    next_mark = window
     while rows < target and not feed.should_stop():
         arrays, count = feed.next_batch_arrays(args.batch_size)
         if count == 0:
             break
         rows += count
+        if rows >= next_mark:
+            marks.append((rows, time.time()))
+            next_mark += window
     elapsed = time.time() - t0
     feed.terminate()
+    rates = []
+    prev_rows, prev_t = 0, t0
+    for r, t in marks:
+        if t > prev_t:
+            rates.append((r - prev_rows) / (t - prev_t))
+        prev_rows, prev_t = r, t
     stats = {"rows": rows, "elapsed": elapsed,
-             "items_per_sec": rows / max(elapsed, 1e-9)}
+             "items_per_sec": rows / max(elapsed, 1e-9),
+             "window_rows": window, "runs": len(rates),
+             "stdev": float(np.std(rates)) if rates else None,
+             "loadavg": [load0, os.getloadavg()[0]]}
     with open(args.stats_path, "w") as f:
         json.dump(stats, f)
     return stats
@@ -497,6 +516,13 @@ def main():
     if feedplane:
         out["feed_plane_images_per_sec"] = round(
             feedplane["items_per_sec"], 1)
+        # variance annotation: per-window rate count/stdev + host loadavg
+        # before/after, so a rate delta across rounds is attributable
+        out["feed_plane_variance"] = {
+            "runs": feedplane.get("runs"),
+            "stdev": None if feedplane.get("stdev") is None
+            else round(feedplane["stdev"], 1),
+            "loadavg": feedplane.get("loadavg")}
         if ceiling:
             out["feed_plane_vs_baseline"] = round(
                 feedplane["items_per_sec"] / ceiling["items_per_sec"], 2)

@@ -244,3 +244,126 @@ def write_synthetic_shards(out_dir, num_examples=64, num_shards=4,
                 w.write(rec)
                 n += 1
     return n
+
+
+# ---------------------------------------------------------------------------
+# Offline pre-decode: the deployment recipe when host cores can't sustain
+# the chip's JPEG consumption rate (PERF.md decode budget; the reference
+# leaned on tf.data's C++ decode pool, ``imagenet_preprocessing.py:87-113``).
+# Decode every JPEG ONCE offline into fixed-size uint8 tensor records;
+# training reads become a frombuffer + cheap uint8 crop — no decoder in the
+# hot path at all.
+# ---------------------------------------------------------------------------
+
+def predecode_shards(src_files, out_dir, store_px=256, label_offset=-1,
+                     progress_every=0):
+    """Rewrite ImageNet JPEG TFRecord shards as fixed-size uint8 tensors.
+
+    Each output record is ``image_raw`` (``store_px x store_px x 3`` uint8,
+    shorter-side-resized + center-cropped — crop/flip augmentation is NOT
+    baked in; it happens cheaply at read time on the uint8 array) plus
+    ``label`` (already ``label_offset``-mapped to 0-based).  Storage cost:
+    ``store_px**2 * 3`` bytes/row (196 KiB at 256px) vs ~110 KiB JPEG —
+    a ~1.8x size trade for a decode-free hot path.
+
+    One output shard per input shard (same basename + ``.raw``), so the
+    FILES-mode per-worker sharding (``data.shard_for_process``) carries
+    over unchanged.
+    """
+    import os
+
+    from tensorflowonspark_tpu import example_proto, tfrecord
+
+    os.makedirs(out_dir, exist_ok=True)
+    outs = []
+    done = 0
+    for path in src_files:
+        out_path = os.path.join(out_dir, os.path.basename(path) + ".raw")
+        with tfrecord.TFRecordWriter(out_path) as w:
+            for rec in tfrecord.tfrecord_iterator(path):
+                feats = example_proto.decode_example(rec)
+                _, encoded = feats["image/encoded"]
+                _, label = feats["image/class/label"]
+                arr = center_crop(encoded[0], store_px,
+                                  resize_shorter=store_px)
+                w.write(example_proto.encode_example({
+                    "image_raw": ("bytes", [np.ascontiguousarray(
+                        arr).tobytes()]),
+                    "label": ("int64", [int(label[0]) + label_offset]),
+                }))
+                done += 1
+                if progress_every and done % progress_every == 0:
+                    print("predecoded %d rows" % done, flush=True)
+        outs.append(out_path)
+    return outs
+
+
+def predecoded_reader(train=True, image_size=224, store_px=256, seed=0,
+                      device_crop=False):
+    """``data.FileFeed`` row reader for :func:`predecode_shards` output.
+
+    Per row: ``np.frombuffer`` + reshape (zero-copy view of the record),
+    then train-time random ``image_size`` crop + horizontal flip (eval:
+    center crop).  No JPEG decoder anywhere.
+
+    Two crop modes:
+
+    - ``device_crop=False``: crop/flip as host uint8 slicing; rows are
+      ``{"image": (S,S,3)}``.  Simple, but the strided crop copy costs
+      ~0.2 ms/row — ~3.5k rows/s/core at the batch assembler.
+    - ``device_crop=True`` (the 8k-rows/s path, docs/PERF.md round 5):
+      pixels ship UNTOUCHED as the full contiguous ``store_px`` row (the
+      host's only per-pixel work is the contiguous batch memcpy) plus
+      sampled ``cropx/cropy/flip`` ints; the crop happens on device via
+      :func:`tensorflowonspark_tpu.ops.augment.crop_and_flip` fused into
+      the jitted step.  Rows are ``{"image": (store_px,store_px,3),
+      "cropx","cropy","flip": int32}``.  CRC verification is skipped
+      (our own writer verified at write time; the crc pass costs more
+      than the whole parse on 196 KB rows).
+
+    Augmentation note: the stored image is already shorter-side-resized to
+    ``store_px``, so the random crop here is the classic fixed-scale crop,
+    not ``random_resized_crop``'s scale/aspect sampling — document the
+    swap when comparing accuracy curves against the JPEG path.
+    """
+    import zlib
+
+    from tensorflowonspark_tpu import example_proto, tfrecord
+
+    def reader(path):
+        rng = np.random.default_rng((seed, zlib.crc32(path.encode())))
+        margin = store_px - image_size
+        for rec in tfrecord.tfrecord_iterator(
+                path, verify_crc=not device_crop):
+            feats = example_proto.decode_example(rec)
+            _, raw = feats["image_raw"]
+            _, label = feats["label"]
+            arr = np.frombuffer(raw[0], np.uint8).reshape(
+                store_px, store_px, 3)
+            if device_crop:
+                if train and margin > 0:
+                    x = int(rng.integers(0, margin + 1))
+                    y = int(rng.integers(0, margin + 1))
+                    flip = int(rng.random() < 0.5)
+                else:
+                    x = y = margin // 2
+                    flip = 0
+                # plain ints, not np scalars: the columnar assembler stacks
+                # them with one np.asarray per column either way, and per-row
+                # np.int32 construction is measurable at these rates
+                yield {"image": arr, "cropx": x, "cropy": y, "flip": flip,
+                       "label": int(label[0])}
+                continue
+            if train and margin > 0:
+                x = int(rng.integers(0, margin + 1))
+                y = int(rng.integers(0, margin + 1))
+                arr = arr[y:y + image_size, x:x + image_size]
+                if rng.random() < 0.5:
+                    arr = arr[:, ::-1]
+            elif margin > 0:
+                off = margin // 2
+                arr = arr[off:off + image_size, off:off + image_size]
+            yield {"image": np.ascontiguousarray(arr),
+                   "label": np.int32(int(label[0]))}
+
+    return reader

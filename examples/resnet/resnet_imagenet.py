@@ -102,12 +102,22 @@ def main_fun(args, ctx):
         # TFRecord rows arrive uint8 (1 byte/pixel over the host->device
         # link); the reference's channel-mean normalization happens HERE,
         # inside the jitted step (imagenet_preprocessing.py equivalent).
+        # Pre-decoded rows additionally carry their sampled crop/flip ints:
+        # the crop itself runs on device too (ops.augment.crop_and_flip),
+        # so the host never touches a pixel.
         import imagenet_input
 
         def loss(p, bs, batch, mask):
+            from tensorflowonspark_tpu.ops import augment
+
             batch = dict(batch)
+            img = batch.pop("image")
+            if args.predecoded:
+                img = augment.crop_and_flip(
+                    img, batch.pop("cropx"), batch.pop("cropy"),
+                    batch.pop("flip"), size)
             batch["image"] = imagenet_input.normalize_on_device(
-                batch["image"], in_dtype)
+                img, in_dtype)
             return base_loss(p, bs, batch, mask)
     else:
         loss = base_loss
@@ -147,10 +157,17 @@ def main_fun(args, ctx):
         from tensorflowonspark_tpu.parallel import infeed
         import imagenet_input
 
-        reader = imagenet_input.imagenet_reader(
-            train=True, image_size=size, seed=jax.process_index())
+        if args.predecoded:
+            reader = imagenet_input.predecoded_reader(
+                train=True, image_size=size, store_px=args.store_px,
+                seed=jax.process_index(), device_crop=True)
+            pattern = "train-*.raw"
+        else:
+            reader = imagenet_input.imagenet_reader(
+                train=True, image_size=size, seed=jax.process_index())
+            pattern = "train-*"
         files = data_mod.list_shards(
-            strip_scheme(ctx.absolute_path(args.data_dir)), pattern="train-*")
+            strip_scheme(ctx.absolute_path(args.data_dir)), pattern=pattern)
         if args.decode_procs:
             # decode is CPU-bound: scale it across cores with worker
             # processes (the tf.data num_parallel_calls role)
@@ -170,9 +187,11 @@ def main_fun(args, ctx):
                 queue_size=8)
         sharded = infeed.ShardedFeed(
             feed, mesh, args.batch_size,
+            # generic passthrough: the predecoded path adds cropx/cropy/flip
+            # int columns next to image/label
             transform=lambda cols: {
-                "image": np.asarray(cols["image"]),
-                "label": np.asarray(cols["label"], np.int32)})
+                k: np.asarray(v, np.int32 if k != "image" else None)
+                for k, v in cols.items()})
 
         def on_steps(s):
             if ckpt:
@@ -352,6 +371,12 @@ def main(argv=None):
                         help="JPEG-decode worker PROCESSES for the train "
                         "feed (0 = in-process reader threads); decode is "
                         "CPU-bound, so size this to the host's spare cores")
+    parser.add_argument("--predecoded", action="store_true",
+                        help="data_dir holds predecode_imagenet.py output "
+                        "(fixed-size uint8 rows, *.raw): decode-free hot "
+                        "path, crop/flip on DEVICE (ops.augment)")
+    parser.add_argument("--store_px", type=int, default=256,
+                        help="stored row size of the predecoded shards")
     parser.add_argument("--model_dir", default=None)
     parser.add_argument("--export_dir", default=None)
     parser.add_argument("--save_interval", type=int, default=1000)

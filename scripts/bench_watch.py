@@ -157,7 +157,10 @@ def main():
     # ends -> SIGHUP) must still remove the pidfile: default signal handling
     # skips atexit, leaving a stale pid that reads as a live watcher
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
-    signal.signal(signal.SIGHUP, lambda *_: sys.exit(129))
+    # respect nohup/disown: only convert SIGHUP to a clean exit when it
+    # would otherwise kill us without running atexit
+    if signal.getsignal(signal.SIGHUP) != signal.SIG_IGN:
+        signal.signal(signal.SIGHUP, lambda *_: sys.exit(129))
 
     deadline = time.time() + args.hours * 3600
     log("watcher started: pid=%d deadline in %.1fh interval=%ds"

@@ -154,11 +154,58 @@ def leg_pool(shards, total, px, procs):
             "startup_secs": startup}
 
 
+def leg_predecoded(shards, px, store_px):
+    """Read rate of the decode-free path: pre-decode the staged JPEG shards
+    once (offline cost, reported), then drain ``predecoded_reader`` through
+    a FileFeed on ONE core — the hot-path rate a training worker would see.
+    This is the extrapolation-free answer to the 8k img/s bar on hosts
+    whose cores can't sustain JPEG decode (VERDICT r4 item 4)."""
+    import imagenet_input
+
+    from tensorflowonspark_tpu import data as data_mod
+
+    # inside the caller's staging dir so the TemporaryDirectory cleanup
+    # sweeps the ~200 KB/row raw shards too
+    pre_dir = os.path.join(os.path.dirname(shards[0]), "predecoded")
+    t0 = time.perf_counter()
+    raw_shards = imagenet_input.predecode_shards(
+        shards, pre_dir, store_px=store_px)
+    predecode_secs = time.perf_counter() - t0
+
+    def drain(device_crop):
+        feed = data_mod.FileFeed(
+            raw_shards, row_reader=imagenet_input.predecoded_reader(
+                train=True, image_size=px, store_px=store_px,
+                device_crop=device_crop),
+            num_epochs=3)
+        n = 0
+        t0 = time.perf_counter()
+        while not feed.should_stop():
+            _, count = feed.next_batch_arrays(64)
+            if count == 0:
+                break
+            n += count
+        rate = round(n / (time.perf_counter() - t0), 1)
+        feed.terminate()
+        return rate, n
+
+    host_rate, n = drain(False)
+    dev_rate, _ = drain(True)
+    return {"rows_per_sec_1core": host_rate,
+            "rows_per_sec_1core_device_crop": dev_rate, "rows": n,
+            "store_px": store_px,
+            "offline_predecode_secs": round(predecode_secs, 2)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", type=int, default=512)
     ap.add_argument("--image_px", type=int, default=224)
-    ap.add_argument("--pool_sizes", default="1,2,4")
+    ap.add_argument("--store_px", type=int, default=256)
+    # scaling curve to 16 procs by default (VERDICT r4 item 4); on a
+    # 1-core host the tail of the curve measures IPC overhead only --
+    # rows_per_sec_per_core is the honest cross-host number
+    ap.add_argument("--pool_sizes", default="1,2,4,8,16")
     args = ap.parse_args()
 
     ncpu = os.cpu_count()
@@ -169,8 +216,14 @@ def main():
         out["pipeline_1core"] = leg_pipeline1(shards, total, args.image_px)
         out["pool"] = [leg_pool(shards, total, args.image_px, int(p))
                        for p in args.pool_sizes.split(",")]
+        for p in out["pool"]:
+            p["rows_per_sec_per_core"] = round(
+                p["rows_per_sec"] / min(p["procs"], ncpu), 1)
+        out["predecoded"] = leg_predecoded(shards, args.image_px,
+                                           args.store_px)
     best = max(p["rows_per_sec"] for p in out["pool"])
-    out["value"] = max(best, out["pipeline_1core"]["train_rows_per_sec"])
+    out["value"] = max(best, out["pipeline_1core"]["train_rows_per_sec"],
+                       out["predecoded"]["rows_per_sec_1core"])
     # the consumption bar: ~8k img/s feeds one v5e chip at 50% MFU
     out["rate_needed_50mfu_1chip"] = 8000
     out["extrapolated_host_rate"] = round(

@@ -171,3 +171,89 @@ class TestReader:
         with pytest.raises(IOError):
             feed.next_batch_arrays(4)
         feed.terminate()
+
+
+class TestPredecoded:
+    """Offline pre-decode path: fixed-size uint8 rows, decode-free reads,
+    and host/device crop parity (the 8k rows/s recipe, PERF.md round 5)."""
+
+    @pytest.fixture
+    def raw_shards(self, tmp_path):
+        src_dir = tmp_path / "jpeg"
+        imagenet_input.write_synthetic_shards(
+            str(src_dir), num_examples=12, num_shards=2, image_size=80)
+        src = data_mod.list_shards(str(src_dir), pattern="train-*")
+        out = imagenet_input.predecode_shards(
+            src, str(tmp_path / "raw"), store_px=64)
+        return out
+
+    def test_roundtrip_shapes_and_labels(self, raw_shards):
+        rows = list(imagenet_input.predecoded_reader(
+            train=False, image_size=48, store_px=64)(raw_shards[0]))
+        assert rows
+        for r in rows:
+            assert r["image"].shape == (48, 48, 3)
+            assert r["image"].dtype == np.uint8
+            assert 0 <= int(r["label"]) < 1000  # 0-based after offset
+
+    def test_train_crop_varies_and_stays_in_bounds(self, raw_shards):
+        rows = list(imagenet_input.predecoded_reader(
+            train=True, image_size=48, store_px=64, seed=1)(raw_shards[0]))
+        assert all(r["image"].shape == (48, 48, 3) for r in rows)
+
+    def test_device_crop_mode_ships_full_rows(self, raw_shards):
+        rows = list(imagenet_input.predecoded_reader(
+            train=True, image_size=48, store_px=64, seed=1,
+            device_crop=True)(raw_shards[0]))
+        for r in rows:
+            assert r["image"].shape == (64, 64, 3)
+            assert 0 <= int(r["cropx"]) <= 16
+            assert 0 <= int(r["cropy"]) <= 16
+            assert int(r["flip"]) in (0, 1)
+
+    def test_device_crop_matches_host_crop(self, raw_shards):
+        """ops.augment.crop_and_flip(device rows) == host-crop rows under
+        the same seed — the two modes are the same augmentation."""
+        from tensorflowonspark_tpu.ops import augment
+
+        mk = lambda device: imagenet_input.predecoded_reader(  # noqa: E731
+            train=True, image_size=48, store_px=64, seed=7,
+            device_crop=device)
+        host = list(mk(False)(raw_shards[0]))
+        dev = list(mk(True)(raw_shards[0]))
+        assert len(host) == len(dev)
+        import jax.numpy as jnp
+
+        out = augment.crop_and_flip(
+            jnp.asarray(np.stack([r["image"] for r in dev])),
+            np.asarray([r["cropx"] for r in dev]),
+            np.asarray([r["cropy"] for r in dev]),
+            np.asarray([r["flip"] for r in dev]), 48)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.stack([r["image"] for r in host]))
+
+    def test_eval_center_crop_deterministic(self, raw_shards):
+        a = list(imagenet_input.predecoded_reader(
+            train=False, image_size=48, store_px=64)(raw_shards[0]))
+        b = list(imagenet_input.predecoded_reader(
+            train=False, image_size=48, store_px=64, seed=99)(raw_shards[0]))
+        for ra, rb in zip(a, b):
+            np.testing.assert_array_equal(ra["image"], rb["image"])
+
+
+def test_tfrecord_verify_crc_off_reads_and_still_catches_truncation(
+        tmp_path):
+    from tensorflowonspark_tpu import example_proto, tfrecord
+
+    path = str(tmp_path / "x.tfrecord")
+    rec = example_proto.encode_example({"a": ("int64", [1])})
+    with tfrecord.TFRecordWriter(path) as w:
+        for _ in range(3):
+            w.write(rec)
+    got = list(tfrecord.tfrecord_iterator(path, verify_crc=False))
+    assert got == [rec] * 3
+    # truncation still detected without crc (framing lengths)
+    data = open(path, "rb").read()
+    open(path, "wb").write(data[:-5])
+    with pytest.raises(IOError):
+        list(tfrecord.tfrecord_iterator(path, verify_crc=False))

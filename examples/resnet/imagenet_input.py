@@ -344,20 +344,25 @@ def predecoded_reader(train=True, image_size=224, store_px=256, seed=0,
                 if train and margin > 0:
                     x = int(rng.integers(0, margin + 1))
                     y = int(rng.integers(0, margin + 1))
-                    flip = int(rng.random() < 0.5)
                 else:
                     x = y = margin // 2
-                    flip = 0
+                # flip is gated on `train` ALONE: with store_px ==
+                # image_size (margin 0) training must still flip 50%,
+                # matching the JPEG path's augmentation.  Drawn AFTER the
+                # crop ints — the host-crop branch consumes the rng in the
+                # same order, so the two modes sample identical augs.
+                flip = int(train and rng.random() < 0.5)
                 # plain ints, not np scalars: the columnar assembler stacks
                 # them with one np.asarray per column either way, and per-row
                 # np.int32 construction is measurable at these rates
                 yield {"image": arr, "cropx": x, "cropy": y, "flip": flip,
                        "label": int(label[0])}
                 continue
-            if train and margin > 0:
-                x = int(rng.integers(0, margin + 1))
-                y = int(rng.integers(0, margin + 1))
-                arr = arr[y:y + image_size, x:x + image_size]
+            if train:
+                if margin > 0:
+                    x = int(rng.integers(0, margin + 1))
+                    y = int(rng.integers(0, margin + 1))
+                    arr = arr[y:y + image_size, x:x + image_size]
                 if rng.random() < 0.5:
                     arr = arr[:, ::-1]
             elif margin > 0:

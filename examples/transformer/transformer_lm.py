@@ -36,7 +36,7 @@ def main_fun(args, ctx):
     ctx.initialize_distributed()
     mesh = mesh_mod.build_mesh(
         mesh_mod.MeshSpec(data=args.data, fsdp=args.fsdp, seq=args.seq,
-                          tensor=args.tensor),
+                          expert=args.expert, tensor=args.tensor),
         keep_trivial_axes=True)
 
     model = tfm.build_transformer(
@@ -45,7 +45,7 @@ def main_fun(args, ctx):
         max_seq_len=args.seq_len,
         attention=args.attention or ("ring" if args.seq > 1 else "full"),
         mlp=args.mlp, num_experts=args.num_experts,
-        mesh=mesh, dtype=args.dtype)
+        ep_mode=args.ep_mode, mesh=mesh, dtype=args.dtype)
     # Init through a full-attention twin: same params, no divisibility
     # constraint on the init batch (see __graft_entry__.dryrun_multichip).
     init_model = tfm.build_transformer(
@@ -60,20 +60,36 @@ def main_fun(args, ctx):
     optimizer = optax.adamw(args.lr)
     loss = tfm.loss_fn(model)
 
-    # batch: dp (data AND fsdp axes) x sp; params/opt state: replicated, or
-    # fsdp-sharded when the fsdp axis is real (parallel/fsdp.py)
-    batch_axes = (("data", "fsdp") if args.fsdp > 1 else "data")
+    # batch: dp (data, fsdp AND expert axes all carry distinct rows) x sp;
+    # params/opt state: replicated, or fsdp-sharded when the fsdp axis is
+    # real (parallel/fsdp.py), with expert-stacked MoE weights overlaid on
+    # the expert axis (parallel/ep.py) when it is
+    batch_axes = tuple(a for a, n in (("data", args.data), ("fsdp", args.fsdp),
+                                      ("expert", args.expert)) if n != 1)
+    batch_axes = batch_axes or "data"
     batch_sharding = NamedSharding(mesh, PartitionSpec(batch_axes, "seq"))
     mask_sharding = NamedSharding(mesh, PartitionSpec(batch_axes))
-    if args.fsdp > 1:
-        from tensorflowonspark_tpu.parallel import fsdp as fsdp_mod
+    def layout(tree):
+        # fsdp rule by shape (scalars/small leaves replicate), then the
+        # expert-stacked MoE leaves overlaid on the expert axis; applies
+        # uniformly to params AND optimizer state (mu/nu mirror the param
+        # paths, so the moe/w* regex matches them too)
+        if args.fsdp > 1:
+            from tensorflowonspark_tpu.parallel import fsdp as fsdp_mod
 
-        params = fsdp_mod.shard_tree(params, mesh)
-        opt_state = fsdp_mod.shard_tree(optimizer.init(params), mesh)
-    else:
-        params = jax.device_put(params, mesh_mod.replicated(mesh))
-        opt_state = jax.device_put(optimizer.init(params),
-                                   mesh_mod.replicated(mesh))
+            shardings = fsdp_mod.tree_shardings(tree, mesh)
+        else:
+            shardings = jax.tree_util.tree_map(
+                lambda _: mesh_mod.replicated(mesh), tree)
+        if args.expert > 1:
+            from tensorflowonspark_tpu.parallel import ep as ep_mod
+
+            shardings = ep_mod.merge_ep_shardings(shardings, tree, mesh)
+        return shardings
+
+    params = jax.device_put(params, layout(params))
+    opt_state = optimizer.init(params)
+    opt_state = jax.device_put(opt_state, layout(opt_state))
 
     def train_step(params, opt_state, tokens, mask):
         (l, _), grads = jax.value_and_grad(loss, has_aux=True)(
@@ -193,6 +209,14 @@ def main(argv=None):
                              "experts (shard experts over the mesh's "
                              "expert axis)")
     parser.add_argument("--num_experts", type=int, default=8)
+    parser.add_argument("--ep_mode", default="gspmd",
+                        choices=["gspmd", "shard_map"],
+                        help="expert parallelism flavor: gspmd lets XLA "
+                        "partition the dispatch einsums; shard_map runs "
+                        "the explicit all_to_all schedule (parallel/ep)")
+    parser.add_argument("--expert", type=int, default=1,
+                        help="mesh expert-axis size (shards the stacked "
+                        "expert weights; tokens route via all_to_all)")
     parser.add_argument("--attention", default=None,
                         choices=[None, "full", "flash", "ring", "ulysses"],
                         help="override the attention kernel (default: ring "

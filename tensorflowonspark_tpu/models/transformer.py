@@ -54,6 +54,25 @@ class Attention(nn.Module):
         return nn.Dense(x.shape[-1], dtype=self.dtype, name="proj")(out)
 
 
+class _RouterParams(nn.Module):
+    """Router weights with ``nn.Dense``'s exact param layout
+    (``{kernel, bias}``) but returned raw instead of applied — the
+    shard_map EP path routes inside the mapped body
+    (:func:`~tensorflowonspark_tpu.parallel.ep.moe_ffn`), so it needs the
+    values, while checkpoints must stay interchangeable with the
+    ``ep_mode="gspmd"`` layer that applies a real Dense."""
+
+    in_dim: int
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (self.in_dim, self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        return kernel, bias
+
+
 class MoEMlp(nn.Module):
     """Switch-style top-1 mixture-of-experts FFN (GShard dispatch/combine).
 
@@ -82,6 +101,14 @@ class MoEMlp(nn.Module):
     num_experts: int = 8
     mlp_ratio: int = 4
     capacity_factor: float = 1.25
+    # "gspmd": dense one-hot einsums, XLA partitions them into all-to-alls
+    # when params/mesh carry the expert axis (zero model coupling to the
+    # mesh).  "shard_map": the explicit DeepSpeed-MoE schedule
+    # (parallel/ep.moe_ffn) — identical math (equality-tested), same
+    # checkpoint layout, but the collectives are written out; requires
+    # ``mesh`` with an ``expert`` axis and the group dim sharded over it.
+    ep_mode: str = "gspmd"
+    mesh: Optional[object] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -95,6 +122,30 @@ class MoEMlp(nn.Module):
 
         # router in fp32: tiny matmul, and routing decisions should not
         # flip with the compute dtype
+        if self.ep_mode == "shard_map":
+            from tensorflowonspark_tpu.parallel import ep as ep_mod
+
+            assert self.mesh is not None, "ep_mode=shard_map needs a mesh"
+            # Declare the SAME param tree nn.Dense would (checkpoints stay
+            # interchangeable with ep_mode="gspmd"), but hand the raw
+            # values to the explicit-EP kernel instead of applying a
+            # submodule.
+            router = _RouterParams(d_model, e, name="router")
+            rk, rb = router()
+            w1 = self.param("w1", nn.initializers.lecun_normal(),
+                            (e, d_model, hidden))
+            b1 = self.param("b1", nn.initializers.zeros, (e, hidden))
+            w2 = self.param("w2", nn.initializers.lecun_normal(),
+                            (e, hidden, d_model))
+            b2 = self.param("b2", nn.initializers.zeros, (e, d_model))
+            y, aux = ep_mod.moe_ffn(
+                x, {"router": {"kernel": rk, "bias": rb},
+                    "w1": w1, "b1": b1, "w2": w2, "b2": b2},
+                self.mesh, e, capacity_factor=self.capacity_factor,
+                dtype=self.dtype)
+            self.sow("intermediates", "moe_aux_loss", aux)
+            return y
+
         logits = nn.Dense(e, dtype=jnp.float32, name="router")(
             x.astype(jnp.float32))                               # [G, S, E]
         probs = jax.nn.softmax(logits, axis=-1)
@@ -145,6 +196,7 @@ class Block(nn.Module):
     mlp: str = "dense"        # dense | moe
     num_experts: int = 8
     capacity_factor: float = 1.25
+    ep_mode: str = "gspmd"    # gspmd | shard_map (see MoEMlp)
     mesh: Optional[object] = None
     dtype: jnp.dtype = jnp.float32
 
@@ -158,6 +210,7 @@ class Block(nn.Module):
             h = MoEMlp(num_experts=self.num_experts,
                        mlp_ratio=self.mlp_ratio,
                        capacity_factor=self.capacity_factor,
+                       ep_mode=self.ep_mode, mesh=self.mesh,
                        dtype=self.dtype, name="moe")(h)
         else:
             h = nn.Dense(x.shape[-1] * self.mlp_ratio, dtype=self.dtype)(h)
@@ -176,6 +229,7 @@ class TransformerLM(nn.Module):
     mlp: str = "dense"        # dense | moe
     num_experts: int = 8
     capacity_factor: float = 1.25
+    ep_mode: str = "gspmd"    # gspmd | shard_map (see MoEMlp)
     mesh: Optional[object] = None
     dtype: jnp.dtype = jnp.float32
 
@@ -191,7 +245,8 @@ class TransformerLM(nn.Module):
             x = Block(self.num_heads, self.head_dim,
                       attention=self.attention, mlp=self.mlp,
                       num_experts=self.num_experts,
-                      capacity_factor=self.capacity_factor, mesh=self.mesh,
+                      capacity_factor=self.capacity_factor,
+                      ep_mode=self.ep_mode, mesh=self.mesh,
                       dtype=self.dtype, name="block_%d" % i)(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # weight-tied readout keeps the big vocab matmul on the MXU once
@@ -203,12 +258,12 @@ class TransformerLM(nn.Module):
 def build_transformer(vocab_size=32000, num_layers=4, num_heads=8,
                       head_dim=64, max_seq_len=2048, attention="full",
                       mlp="dense", num_experts=8, capacity_factor=1.25,
-                      mesh=None, dtype="float32"):
+                      ep_mode="gspmd", mesh=None, dtype="float32"):
     return TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
                          num_heads=num_heads, head_dim=head_dim,
                          max_seq_len=max_seq_len, attention=attention,
                          mlp=mlp, num_experts=num_experts,
-                         capacity_factor=capacity_factor,
+                         capacity_factor=capacity_factor, ep_mode=ep_mode,
                          mesh=mesh, dtype=jnp.dtype(dtype))
 
 

@@ -148,3 +148,30 @@ def moe_ffn(x, params, mesh, num_experts, capacity_factor=1.25,
         out_specs=(P(axis), P()))
     return fn(x, params["router"]["kernel"], params["router"]["bias"],
               params["w1"], params["b1"], params["w2"], params["b2"])
+
+
+def merge_ep_shardings(base_shardings, params, mesh, axis="expert",
+                       pattern=MOE_PARAM_RE):
+    """Overlay expert parallelism on an existing sharding layout.
+
+    ``base_shardings`` (e.g. replicated, or :func:`..fsdp.tree_shardings`)
+    keeps every leaf EXCEPT the expert-stacked MoE weights, which take the
+    ``axis``-on-dim-0 spec from :func:`ep_param_shardings` — the merged
+    tree is the canonical fsdp-everything + expert-for-experts layout
+    (used by ``__graft_entry__``'s moe/fsdp/ep dryrun phase and the
+    transformer example's ``--expert`` mode)."""
+    import jax
+
+    from tensorflowonspark_tpu.parallel import tp as tp_mod
+
+    ep_tree = ep_param_shardings(params, mesh, axis=axis, pattern=pattern)
+    pat = pattern if hasattr(pattern, "search") else None
+    import re
+
+    if pat is None:
+        pat = re.compile(pattern)
+
+    def pick(path, base, ep_leaf):
+        return ep_leaf if pat.search(tp_mod._param_path(path)) else base
+
+    return jax.tree_util.tree_map_with_path(pick, base_shardings, ep_tree)

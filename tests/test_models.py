@@ -254,6 +254,38 @@ class TestMoE:
         np.testing.assert_allclose(np.asarray(out), np.asarray(base),
                                    rtol=2e-3, atol=2e-3)
 
+    def test_ep_mode_shard_map_matches_gspmd(self):
+        """ep_mode="shard_map" (the explicit all_to_all schedule inside the
+        flax layer) must match the default GSPMD layer bit-for-bit-ish:
+        same checkpoint layout, same forward, same folded aux loss."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tensorflowonspark_tpu.models import transformer
+        from tensorflowonspark_tpu.parallel import build_mesh
+
+        mesh = build_mesh({"data": 4, "expert": 2})
+        dense = self._model()
+        ep = self._model(ep_mode="shard_map", mesh=mesh)
+        tokens = jnp.asarray(np.arange(4 * 16).reshape(4, 16) % 64,
+                             jnp.int32)
+        params = dense.init(jax.random.PRNGKey(0), tokens)["params"]
+        # identical param trees (checkpoints interchangeable)
+        ep_params = ep.init(jax.random.PRNGKey(0), tokens)["params"]
+        assert (jax.tree_util.tree_structure(params)
+                == jax.tree_util.tree_structure(ep_params))
+        loss = transformer.loss_fn(dense)
+        ep_loss = transformer.loss_fn(ep)
+        mask = jnp.ones((4,), jnp.float32)
+        l0, aux0 = loss(params, {"tokens": tokens}, mask)
+        with mesh:
+            l1, aux1 = jax.jit(
+                lambda p: ep_loss(p, {"tokens": tokens}, mask))(params)
+        np.testing.assert_allclose(float(l1), float(l0), rtol=2e-5)
+        np.testing.assert_allclose(float(aux1["moe_aux_loss"]),
+                                   float(aux0["moe_aux_loss"]), rtol=2e-5)
+
 
 class TestS2dStem:
     def test_stem_kernel_transform_exact(self):

@@ -24,6 +24,12 @@ others) with one retry:
    the reference's achievable e2e images/sec regardless of accelerator (the
    reference publishes no numbers, BASELINE.md).
 
+Plus one beyond-baseline leg: **transformer-LM MFU** — a decoder-only LM
+whose FLOPs are ~90% dense matmuls, measuring what fraction of the matmul
+ceiling (82-87% of v5e peak, scripts/device_validate.py) the full Trainer
+path keeps when the op mix is MXU-shaped.  It runs LAST so a tunnel flap
+mid-compile cannot cost the graded legs above.
+
 Prints ONE JSON line:
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
@@ -61,13 +67,25 @@ RESNET_STEM = os.environ.get("TFOS_BENCH_RESNET_STEM", "s2d")
 # on): N shrinks to [N,N,N,N] so the leg CONTRACT is testable on hosts
 # where the full-model XLA compile takes minutes (1-core CPU).
 RESNET_BLOCKS = int(os.environ.get("TFOS_BENCH_RESNET_BLOCKS", 0))
+# Transformer-LM leg (the MXU-friendly flagship): ~90% of its FLOPs are
+# dense matmuls, so its MFU shows what fraction of the measured matmul
+# ceiling (82-87% of v5e peak, device_validate) the full Trainer path
+# keeps when the op mix is MXU-shaped — the complement of the conv-bound
+# ResNet headline.  Defaults match scripts/k_ladder.py transformer_ladder.
+LM_BATCH = int(os.environ.get("TFOS_BENCH_LM_BATCH", 8))
+LM_SEQ = int(os.environ.get("TFOS_BENCH_LM_SEQ", 1024))
+LM_LAYERS = int(os.environ.get("TFOS_BENCH_LM_LAYERS", 8))
+LM_HEADS = int(os.environ.get("TFOS_BENCH_LM_HEADS", 16))
+LM_VOCAB = int(os.environ.get("TFOS_BENCH_LM_VOCAB", 32000))
+LM_STEPS = int(os.environ.get("TFOS_BENCH_LM_STEPS", 60))
+LM_STEPS_PER_CALL = int(os.environ.get("TFOS_BENCH_LM_SPC", 20))
 
-# resnet gets extra headroom: its cold path compiles TWO programs over the
-# remote-compile tunnel (the canonical single-step module for MFU flops +
-# the k-step scan program); the persistent compile cache makes retries and
-# later runs fast, but the first attempt must fit.
-LEG_TIMEOUT_SECS = {"mnist": 1500, "resnet": 1800, "feedplane": 600,
-                    "ceiling": 120}
+# resnet/transformer get extra headroom: their cold paths compile TWO
+# programs over the remote-compile tunnel (the canonical single-step module
+# for MFU flops + the k-step scan program); the persistent compile cache
+# makes retries and later runs fast, but the first attempt must fit.
+LEG_TIMEOUT_SECS = {"mnist": 1500, "resnet": 1800, "transformer": 1800,
+                    "feedplane": 600, "ceiling": 120}
 
 
 # ---------------------------------------------------------------------------
@@ -165,6 +183,39 @@ def mnist_main(args, ctx):
     return stats
 
 
+def _run_synthetic_leg(trainer, batch, mask, k, steps, stats_path, chief):
+    """Warm up, measure ``steps`` over one device-resident batch (the
+    reference's benchmark mode, ``common.py:315-363``), write stats.
+
+    The ONE warmup/measure/stats block for every synthetic compute leg
+    (resnet + transformer): K steps per dispatch via ``repeat_step``
+    (lax.scan — same per-step math, host dispatch amortized by K; the
+    production fit_feed path gets the same effect through
+    ``ShardedFeed.grouped_batches``), or plain ``step`` at K=1."""
+    import jax
+
+    if k > 1:
+        for _ in range(2):
+            loss = trainer.repeat_step(batch, mask, k)
+        trainer.reset_history()
+        for _ in range(max(steps // k, 1)):
+            loss = trainer.repeat_step(batch, mask, k)
+    else:
+        for _ in range(5):
+            loss, _ = trainer.step(batch, mask)
+        trainer.reset_history()
+        for _ in range(steps):
+            loss, _ = trainer.step(batch, mask)
+    trainer.history.on_train_end(loss)
+    stats = trainer.history.build_stats(loss=float(loss))
+    stats["n_devices"] = len(jax.devices())
+    stats["device_kind"] = jax.devices()[0].device_kind
+    if chief:
+        with open(stats_path, "w") as f:
+            json.dump(stats, f, default=float)
+    return stats
+
+
 def resnet_main(args, ctx):
     """Runs on the executor: ResNet-50 v1.5, synthetic ImageNet batch
     (reference benchmark mode, ``common.py:315-363``)."""
@@ -200,32 +251,66 @@ def resnet_main(args, ctx):
         "label": jax.device_put(
             rng.integers(0, 1000, (args.batch_size,)), sharding),
     }
-    k = getattr(args, "steps_per_call", 1)
-    if k > 1:
-        # K steps per dispatch (lax.scan over the one device-resident batch,
-        # reference benchmark mode) — same per-step math, host dispatch
-        # amortized by K (the production fit_feed path gets the same effect
-        # via ShardedFeed.grouped_batches).
-        mask = jnp.ones((args.batch_size,), jnp.float32)
-        for _ in range(2):
-            loss = trainer.repeat_step(batch, mask, k)
-        trainer.reset_history()
-        for _ in range(max(args.steps // k, 1)):
-            loss = trainer.repeat_step(batch, mask, k)
-    else:
-        for _ in range(5):
-            loss, _ = trainer.step(batch)
-        trainer.reset_history()
-        for _ in range(args.steps):
-            loss, _ = trainer.step(batch)
-    trainer.history.on_train_end(loss)
-    stats = trainer.history.build_stats(loss=float(loss))
-    stats["n_devices"] = len(jax.devices())
-    stats["device_kind"] = jax.devices()[0].device_kind
-    if ctx.is_chief():
-        with open(args.stats_path, "w") as f:
-            json.dump(stats, f, default=float)
-    return stats
+    mask = jnp.ones((args.batch_size,), jnp.float32)
+    return _run_synthetic_leg(
+        trainer, batch, mask, getattr(args, "steps_per_call", 1), args.steps,
+        args.stats_path, ctx.is_chief())
+
+
+def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
+                     vocab=None, log_steps=20):
+    """(trainer, batch, mask) for the transformer-LM leg on the current
+    backend's mesh — the ONE place the flagship LM benchmark model is
+    defined.  ``scripts/k_ladder.py`` measures the same construction, so
+    the ladder that justified ``LM_STEPS_PER_CALL`` and the bench's
+    ``transformer_lm_train_mfu`` can never drift apart."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import train as train_mod
+    from tensorflowonspark_tpu.models import transformer
+    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+    batch_size = LM_BATCH if batch_size is None else batch_size
+    seq = LM_SEQ if seq is None else seq
+    layers = LM_LAYERS if layers is None else layers
+    heads = LM_HEADS if heads is None else heads
+    vocab = LM_VOCAB if vocab is None else vocab
+
+    mesh = mesh_mod.build_mesh()
+    model = transformer.build_transformer(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        head_dim=64, max_seq_len=seq, dtype="bfloat16")
+    tokens = np.arange(batch_size * seq,
+                       dtype=np.int32).reshape(batch_size, seq)
+    tokens %= vocab
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.asarray(tokens[:1]))["params"]
+    trainer = train_mod.Trainer(
+        transformer.loss_fn(model), params, optax.adam(1e-3), mesh=mesh,
+        compute_dtype=jnp.bfloat16, batch_size=batch_size,
+        log_steps=log_steps)
+    sharding = mesh_mod.batch_sharding(mesh, extra_dims=1)
+    batch = {"tokens": jax.device_put(jnp.asarray(tokens), sharding)}
+    mask = jax.device_put(np.ones((batch_size,), np.float32),
+                          mesh_mod.batch_sharding(mesh))
+    config = {"batch": batch_size, "seq": seq, "layers": layers,
+              "heads": heads, "vocab": vocab}
+    return trainer, batch, mask, config
+
+
+def transformer_main(args, ctx):
+    """Runs on the executor: decoder-only LM (weight-tied readout, bf16),
+    one synthetic device-resident token batch (the reference's benchmark
+    mode shape, ``common.py:315-363``), K steps per dispatch."""
+    ctx.initialize_distributed()
+    trainer, batch, mask, _ = build_lm_trainer(
+        batch_size=args.batch_size, seq=args.seq, layers=args.layers,
+        heads=args.heads, vocab=args.vocab)
+    return _run_synthetic_leg(
+        trainer, batch, mask, args.steps_per_call, args.steps,
+        args.stats_path, ctx.is_chief())
 
 
 # ---------------------------------------------------------------------------
@@ -290,6 +375,17 @@ def measure_resnet50(batch_size=RESNET_BATCH, steps=RESNET_STEPS):
         blocks_per_stage=RESNET_BLOCKS or None,
         stats_path=os.path.join(tempfile.mkdtemp(), "resnet_stats.json"))
     return _run_cluster(resnet_main, args, cluster.InputMode.FILES)
+
+
+def measure_transformer(batch_size=LM_BATCH, steps=LM_STEPS):
+    from tensorflowonspark_tpu import cluster
+
+    args = argparse.Namespace(
+        batch_size=batch_size, steps=steps, chunk_size=1024,
+        steps_per_call=LM_STEPS_PER_CALL, seq=LM_SEQ, layers=LM_LAYERS,
+        heads=LM_HEADS, vocab=LM_VOCAB,
+        stats_path=os.path.join(tempfile.mkdtemp(), "lm_stats.json"))
+    return _run_cluster(transformer_main, args, cluster.InputMode.FILES)
 
 
 def feedplane_main(args, ctx):
@@ -386,6 +482,7 @@ def measure_reference_feed_ceiling(n_items=60000):
 _LEGS = {
     "mnist": measure_mnist_e2e,
     "resnet": measure_resnet50,
+    "transformer": measure_transformer,
     "feedplane": measure_feedplane,
     "ceiling": measure_reference_feed_ceiling,
 }
@@ -442,15 +539,30 @@ def probe_device(timeout=150, attempts=3, retry_sleep=120):
 
 def run_leg_isolated(leg, retries=1):
     """Execute a leg with subprocess isolation + retry; returns
-    ``(stats_or_None, error_or_None)``."""
+    ``(stats_or_None, error_or_None)``.
+
+    When ``TFOS_BENCH_PARTIAL_DIR`` is set, each completed leg's raw stats
+    are also dropped there as ``<leg>.json`` — so a supervisor that kills
+    the whole bench mid-run (e.g. bench_watch's umbrella timeout during a
+    tunnel flap) still keeps the evidence of every leg that finished."""
     err = None
+    partial_dir = os.environ.get("TFOS_BENCH_PARTIAL_DIR")
     for attempt in range(retries + 1):
         out_path = os.path.join(tempfile.mkdtemp(), leg + ".json")
         try:
             proc = _leg_subprocess(leg, out_path)
             if proc.returncode == 0 and os.path.exists(out_path):
                 with open(out_path) as f:
-                    return json.load(f), None
+                    stats = json.load(f)
+                if partial_dir:
+                    try:
+                        os.makedirs(partial_dir, exist_ok=True)
+                        with open(os.path.join(
+                                partial_dir, leg + ".json"), "w") as f:
+                            json.dump(stats, f)
+                    except OSError:
+                        pass  # evidence drop is best-effort
+                return stats, None
             err = "leg {} rc={} (attempt {})".format(
                 leg, proc.returncode, attempt + 1)
         except subprocess.TimeoutExpired:
@@ -470,8 +582,8 @@ def main():
     if probe_err:
         print("bench: {} -- skipping device legs".format(probe_err),
               file=sys.stderr)
-        resnet = mnist = None
-        resnet_err = mnist_err = probe_err
+        resnet = mnist = lm = None
+        resnet_err = mnist_err = lm_err = probe_err
     else:
         # cheapest-first (VERDICT r4): MNIST compiles in seconds, ResNet's
         # cold compile takes minutes — a tunnel flap mid-round must keep
@@ -481,6 +593,13 @@ def main():
     # device-free legs: run regardless of accelerator health
     feedplane, feedplane_err = run_leg_isolated("feedplane")
     ceiling, ceiling_err = run_leg_isolated("ceiling")
+    if not probe_err:
+        # The transformer leg runs LAST — after every graded leg,
+        # including the device-free ones: it is beyond the BASELINE
+        # targets (extra evidence, not the headline), so a flap burning
+        # its retry budget must not starve anything graded of the
+        # supervisor's umbrella time.
+        lm, lm_err = run_leg_isolated("transformer")
 
     out = {
         # Compute headline: the MFU target lives on ResNet-50 (BASELINE.md).
@@ -512,6 +631,16 @@ def main():
         "mnist_config": {"batch": MNIST_BATCH, "steps_per_call":
                          MNIST_STEPS_PER_CALL, "epochs": MNIST_EPOCHS,
                          "rows": MNIST_ROWS},
+        # MXU-friendly flagship (beyond-baseline evidence): what MFU the
+        # Trainer path sustains when the op mix is matmul-shaped.
+        "transformer_lm_train_mfu": round(lm["mfu"], 4)
+        if lm and lm.get("mfu") is not None else None,
+        "transformer_lm_step_time_ms": round(
+            1000 * lm["avg_step_seconds"], 2) if lm else None,
+        "transformer_lm_config": {
+            "batch": LM_BATCH, "seq": LM_SEQ, "layers": LM_LAYERS,
+            "heads": LM_HEADS, "vocab": LM_VOCAB,
+            "steps_per_call": LM_STEPS_PER_CALL},
     }
     if feedplane:
         out["feed_plane_images_per_sec"] = round(
@@ -543,6 +672,7 @@ def main():
             out["unit"] = "images/sec/chip"
     for name, err in (("resnet50_error", resnet_err),
                       ("mnist_error", mnist_err),
+                      ("transformer_error", lm_err),
                       ("ceiling_error", ceiling_err)):
         if err:
             out[name] = err

@@ -39,13 +39,22 @@ def main_fun(args, ctx):
                           expert=args.expert, tensor=args.tensor),
         keep_trivial_axes=True)
 
+    # batch: dp (data, fsdp AND expert axes all carry distinct rows) x sp —
+    # computed before the model so the shard_map EP kernel can keep the
+    # group dim partitioned over the same axes (ep_batch_axes) instead of
+    # all-gathering the batch onto every expert shard
+    batch_axes = tuple(a for a, n in (("data", args.data), ("fsdp", args.fsdp),
+                                      ("expert", args.expert)) if n != 1)
+    batch_axes = batch_axes or "data"
+
     model = tfm.build_transformer(
         vocab_size=args.vocab_size, num_layers=args.num_layers,
         num_heads=args.num_heads, head_dim=args.head_dim,
         max_seq_len=args.seq_len,
         attention=args.attention or ("ring" if args.seq > 1 else "full"),
         mlp=args.mlp, num_experts=args.num_experts,
-        ep_mode=args.ep_mode, mesh=mesh, dtype=args.dtype)
+        ep_mode=args.ep_mode, mesh=mesh, ep_batch_axes=batch_axes,
+        dtype=args.dtype)
     # Init through a full-attention twin: same params, no divisibility
     # constraint on the init batch (see __graft_entry__.dryrun_multichip).
     init_model = tfm.build_transformer(
@@ -60,13 +69,9 @@ def main_fun(args, ctx):
     optimizer = optax.adamw(args.lr)
     loss = tfm.loss_fn(model)
 
-    # batch: dp (data, fsdp AND expert axes all carry distinct rows) x sp;
     # params/opt state: replicated, or fsdp-sharded when the fsdp axis is
     # real (parallel/fsdp.py), with expert-stacked MoE weights overlaid on
     # the expert axis (parallel/ep.py) when it is
-    batch_axes = tuple(a for a, n in (("data", args.data), ("fsdp", args.fsdp),
-                                      ("expert", args.expert)) if n != 1)
-    batch_axes = batch_axes or "data"
     batch_sharding = NamedSharding(mesh, PartitionSpec(batch_axes, "seq"))
     mask_sharding = NamedSharding(mesh, PartitionSpec(batch_axes))
     def layout(tree):

@@ -91,10 +91,17 @@ def run_bench():
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(ROOT, ".jax_cache"))
+    # every completed leg's raw stats persist here even if the umbrella
+    # timeout below kills the run mid-leg (tunnel flap evidence)
+    env.setdefault("TFOS_BENCH_PARTIAL_DIR", os.path.join(OUT_DIR, "legs"))
     with open(logf, "a") as lf:
+        # umbrella > sum of single-attempt leg timeouts (1500+1800+1800+
+        # 600+120 = 5820s): every leg must get one full cold-compile
+        # attempt before the supervisor gives up; per-leg stats persist
+        # via TFOS_BENCH_PARTIAL_DIR even if this trips mid-run
         proc = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
                               cwd=ROOT, env=env, stdout=subprocess.PIPE,
-                              stderr=lf, text=True, timeout=4500)
+                              stderr=lf, text=True, timeout=7200)
     line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
     if line:
         with open(out, "w") as f:

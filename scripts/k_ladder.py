@@ -122,36 +122,20 @@ def resnet_ladder(ks, repeats, batch_size, blocks):
     return _measure(trainer, batch, mask, ks, repeats)
 
 
-def transformer_ladder(ks, repeats, batch=8, seq=1024, layers=8, heads=16,
-                       vocab=32000):
+def transformer_ladder(ks, repeats, **overrides):
     """The MXU-friendly flagship: a ~134M-param decoder-only LM (bf16,
     weight-tied readout).  Attention is quadratic-but-small at this seq;
     ~90% of FLOPs are dense matmuls, so this leg shows what fraction of
     the matmul ceiling (82-87% of peak measured, device_validate) the full
-    Trainer path keeps."""
-    import jax
-    import jax.numpy as jnp
-    import optax
+    Trainer path keeps.
 
-    from tensorflowonspark_tpu import train as train_mod
-    from tensorflowonspark_tpu.models import transformer
-    from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+    Model + shapes come from ``bench.build_lm_trainer`` (same LM_* env
+    knobs) so the ladder always measures exactly the model the bench's
+    ``transformer_lm_train_mfu`` headline runs."""
+    import bench
 
-    mesh = mesh_mod.build_mesh()
-    model = transformer.build_transformer(
-        vocab_size=vocab, num_layers=layers, num_heads=heads, head_dim=64,
-        max_seq_len=seq, dtype="bfloat16")
-    tokens = np.arange(batch * seq, dtype=np.int32).reshape(batch, seq)
-    tokens %= vocab
-    params = model.init(jax.random.PRNGKey(0),
-                        jnp.asarray(tokens[:1]))["params"]
-    trainer = train_mod.Trainer(
-        transformer.loss_fn(model), params, optax.adam(1e-3), mesh=mesh,
-        compute_dtype=jnp.bfloat16, batch_size=batch, log_steps=10**9)
-    shard = mesh_mod.batch_sharding(mesh, extra_dims=1)
-    batch_d = {"tokens": jax.device_put(jnp.asarray(tokens), shard)}
-    mask = jax.device_put(np.ones((batch,), np.float32),
-                          mesh_mod.batch_sharding(mesh))
+    trainer, batch_d, mask, config = bench.build_lm_trainer(
+        log_steps=10 ** 9, **overrides)
     out = _measure(trainer, batch_d, mask, ks, repeats)
     from tensorflowonspark_tpu import metrics as metrics_mod
 
@@ -163,8 +147,7 @@ def transformer_ladder(ks, repeats, batch=8, seq=1024, layers=8, heads=16,
         for row in out["ladder"]:
             row["mfu_pct"] = round(
                 100 * flops / peak / (row["ms_per_step"] / 1e3), 1)
-    out["config"] = {"batch": batch, "seq": seq, "layers": layers,
-                     "heads": heads, "vocab": vocab}
+    out["config"] = config
     return out
 
 

@@ -109,6 +109,11 @@ class MoEMlp(nn.Module):
     # ``mesh`` with an ``expert`` axis and the group dim sharded over it.
     ep_mode: str = "gspmd"
     mesh: Optional[object] = None
+    # shard_map mode only: mesh axes the caller's batch sharding puts on the
+    # group dim (e.g. ("data", "fsdp", "expert")); the EP kernel keeps the
+    # batch partitioned over them instead of all-gathering it onto every
+    # expert shard.  None = ("expert",) (pure EP).
+    ep_batch_axes: Optional[tuple] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -142,7 +147,7 @@ class MoEMlp(nn.Module):
                 x, {"router": {"kernel": rk, "bias": rb},
                     "w1": w1, "b1": b1, "w2": w2, "b2": b2},
                 self.mesh, e, capacity_factor=self.capacity_factor,
-                dtype=self.dtype)
+                dtype=self.dtype, batch_axes=self.ep_batch_axes)
             self.sow("intermediates", "moe_aux_loss", aux)
             return y
 
@@ -198,6 +203,7 @@ class Block(nn.Module):
     capacity_factor: float = 1.25
     ep_mode: str = "gspmd"    # gspmd | shard_map (see MoEMlp)
     mesh: Optional[object] = None
+    ep_batch_axes: Optional[tuple] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -211,6 +217,7 @@ class Block(nn.Module):
                        mlp_ratio=self.mlp_ratio,
                        capacity_factor=self.capacity_factor,
                        ep_mode=self.ep_mode, mesh=self.mesh,
+                       ep_batch_axes=self.ep_batch_axes,
                        dtype=self.dtype, name="moe")(h)
         else:
             h = nn.Dense(x.shape[-1] * self.mlp_ratio, dtype=self.dtype)(h)
@@ -231,6 +238,7 @@ class TransformerLM(nn.Module):
     capacity_factor: float = 1.25
     ep_mode: str = "gspmd"    # gspmd | shard_map (see MoEMlp)
     mesh: Optional[object] = None
+    ep_batch_axes: Optional[tuple] = None
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -247,6 +255,7 @@ class TransformerLM(nn.Module):
                       num_experts=self.num_experts,
                       capacity_factor=self.capacity_factor,
                       ep_mode=self.ep_mode, mesh=self.mesh,
+                      ep_batch_axes=self.ep_batch_axes,
                       dtype=self.dtype, name="block_%d" % i)(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # weight-tied readout keeps the big vocab matmul on the MXU once
@@ -258,13 +267,15 @@ class TransformerLM(nn.Module):
 def build_transformer(vocab_size=32000, num_layers=4, num_heads=8,
                       head_dim=64, max_seq_len=2048, attention="full",
                       mlp="dense", num_experts=8, capacity_factor=1.25,
-                      ep_mode="gspmd", mesh=None, dtype="float32"):
+                      ep_mode="gspmd", mesh=None, ep_batch_axes=None,
+                      dtype="float32"):
     return TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
                          num_heads=num_heads, head_dim=head_dim,
                          max_seq_len=max_seq_len, attention=attention,
                          mlp=mlp, num_experts=num_experts,
                          capacity_factor=capacity_factor, ep_mode=ep_mode,
-                         mesh=mesh, dtype=jnp.dtype(dtype))
+                         mesh=mesh, ep_batch_axes=ep_batch_axes,
+                         dtype=jnp.dtype(dtype))
 
 
 def _sum_moe_aux(tree):

@@ -83,18 +83,28 @@ def _route(x, router_kernel, router_bias, num_experts, capacity):
 
 
 def moe_ffn(x, params, mesh, num_experts, capacity_factor=1.25,
-            axis="expert", dtype=None):
+            axis="expert", dtype=None, batch_axes=None):
     """Grouped top-1 MoE FFN with explicit expert parallelism.
 
     Args:
-      x: ``[G, S, D]`` activations; the leading group dim must be sharded
-        over ``axis`` (``G % mesh.shape[axis] == 0``).
+      x: ``[G, S, D]`` activations; the leading group dim is sharded over
+        ``batch_axes`` inside the kernel (``G`` divisible by their product).
+        The sequence dim is whole inside the kernel (routing's capacity
+        cumsum is over the full sequence); a seq-sharded input is gathered
+        at the kernel boundary and re-scattered after.
       params: dict with ``router/kernel [D,E]``, ``router/bias [E]``,
         ``w1 [E,D,H]``, ``b1 [E,H]``, ``w2 [E,H,D]``, ``b2 [E,D]`` —
         exactly ``MoEMlp``'s layout (pass
         ``flax_params["moe"]`` + ``flax_params["router"]`` leaves).
       mesh: the device mesh; ``axis`` must be one of its axes.
       num_experts: E (must be divisible by ``mesh.shape[axis]``).
+      batch_axes: mesh axes the group dim is sharded over — pass the SAME
+        axes the caller's batch sharding uses (e.g. ``("data", "fsdp",
+        "expert")``) so the kernel keeps data parallelism instead of
+        all-gathering the batch onto every expert shard and redoing the
+        FFN per data shard.  Default ``(axis,)`` (pure EP).  ``axis`` is
+        appended automatically when absent — the two ``all_to_all`` hops
+        ride it, so the group dim must be partitioned over it.
 
     Returns:
       ``(y [G,S,D], aux_loss scalar)`` — numerically identical to the dense
@@ -106,13 +116,28 @@ def moe_ffn(x, params, mesh, num_experts, capacity_factor=1.25,
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    if batch_axes is None:
+        batch_axes = (axis,)
+    elif isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    else:
+        batch_axes = tuple(batch_axes)
+    if axis not in batch_axes:
+        # the two all_to_alls ride ``axis``, so the group dim must be
+        # partitioned over it inside the kernel; appending it is a no-op
+        # for the caller (shard_map re-lays out the input to in_specs)
+        batch_axes = batch_axes + (axis,)
     ep = mesh.shape[axis]
+    group_shards = 1
+    for a in batch_axes:
+        group_shards *= mesh.shape[a]
     assert num_experts % ep == 0, (
         "num_experts {} not divisible by expert axis size {}".format(
             num_experts, ep))
-    assert x.shape[0] % ep == 0, (
-        "group dim {} not divisible by expert axis size {} (the leading "
-        "dim must shard over {!r})".format(x.shape[0], ep, axis))
+    assert x.shape[0] % group_shards == 0, (
+        "group dim {} not divisible by the {} shards of batch_axes {} (the "
+        "leading dim must shard over them)".format(
+            x.shape[0], group_shards, batch_axes))
     dtype = dtype or x.dtype
     seq = x.shape[1]
     capacity = max(int(capacity_factor * seq / num_experts), 1)
@@ -135,17 +160,19 @@ def moe_ffn(x, params, mesh, num_experts, capacity_factor=1.25,
                              tiled=True)
         combine = dispatch * expert_prob.astype(dtype)[..., None, None]
         y = jnp.einsum("gsec,gecd->gsd", combine, out)
-        # global Switch aux: every shard routed its own groups, so the
-        # global fraction/mean_prob are the means across the axis
-        fraction = lax.pmean(fraction, axis)
-        mean_prob = lax.pmean(mean_prob, axis)
+        # global Switch aux: every shard routed its own (equal-size) slice
+        # of the groups, so the global fraction/mean_prob are the means
+        # across every axis the group dim is sharded over
+        fraction = lax.pmean(fraction, batch_axes)
+        mean_prob = lax.pmean(mean_prob, batch_axes)
         aux = num_experts * jnp.sum(fraction * mean_prob)
         return y, aux
 
     fn = shard_map(
         local, mesh=mesh,
-        in_specs=(P(axis), P(), P(), P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(axis), P()))
+        in_specs=(P(batch_axes), P(), P(), P(axis), P(axis), P(axis),
+                  P(axis)),
+        out_specs=(P(batch_axes), P()))
     return fn(x, params["router"]["kernel"], params["router"]["bias"],
               params["w1"], params["b1"], params["w2"], params["b2"])
 
@@ -165,11 +192,7 @@ def merge_ep_shardings(base_shardings, params, mesh, axis="expert",
     from tensorflowonspark_tpu.parallel import tp as tp_mod
 
     ep_tree = ep_param_shardings(params, mesh, axis=axis, pattern=pattern)
-    pat = pattern if hasattr(pattern, "search") else None
-    import re
-
-    if pat is None:
-        pat = re.compile(pattern)
+    pat = pattern if hasattr(pattern, "search") else re.compile(pattern)
 
     def pick(path, base, ep_leaf):
         return ep_leaf if pat.search(tp_mod._param_path(path)) else base

@@ -458,6 +458,37 @@ class TestExpertParallel:
                                    rtol=2e-5, atol=2e-5)
         np.testing.assert_allclose(float(aux), float(aux_dense), rtol=1e-5)
 
+    def test_moe_ffn_batch_axes_matches_dense(self):
+        """Group dim sharded over data AND expert (the layout the
+        transformer example feeds, via ep_batch_axes): identical to dense.
+        Without batch_axes the kernel would all-gather the batch onto
+        every expert shard and redo the FFN per data shard."""
+        from tensorflowonspark_tpu.models.transformer import MoEMlp
+        from tensorflowonspark_tpu.parallel import ep
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        model = MoEMlp(num_experts=4, mlp_ratio=2, capacity_factor=1.0)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((8, 16, 8)), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x)["params"]
+        dense, state = model.apply({"params": params}, x,
+                                   mutable=["intermediates"])
+        aux_dense = state["intermediates"]["moe_aux_loss"][0]
+
+        mesh = build_mesh({"data": 4, "expert": 2})
+        xs = jax.device_put(x, NamedSharding(mesh, P(("data", "expert"))))
+        y, aux = ep.moe_ffn(xs, params, mesh, num_experts=4,
+                            capacity_factor=1.0,
+                            batch_axes=("data", "expert"))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(float(aux), float(aux_dense), rtol=1e-5)
+        # the expert axis is auto-appended when the caller omits it
+        y2, aux2 = ep.moe_ffn(xs, params, mesh, num_experts=4,
+                              capacity_factor=1.0, batch_axes=("data",))
+        np.testing.assert_allclose(np.asarray(y2), np.asarray(dense),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_moe_ffn_grads_match_dense(self):
         from tensorflowonspark_tpu.parallel import ep
         from jax.sharding import NamedSharding, PartitionSpec as P

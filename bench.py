@@ -686,7 +686,11 @@ if __name__ == "__main__":
     cli = parser.parse_args()
     if cli.leg:
         stats = _LEGS[cli.leg]()
-        with open(cli.out, "w") as f:
-            json.dump(stats, f, default=float)
+        # Always emit to stdout so a forgotten --out can't discard a
+        # measurement that cost minutes of scarce tunnel time (it did once).
+        print(json.dumps(stats, default=float), flush=True)
+        if cli.out:
+            with open(cli.out, "w") as f:
+                json.dump(stats, f, default=float)
     else:
         main()

@@ -92,6 +92,28 @@ PJRT_Error* EventIsReady(PJRT_Event_IsReady_Args* args) {
 PJRT_Error* PluginInitialize(PJRT_Plugin_Initialize_Args*) { return nullptr; }
 
 PJRT_Error* ClientCreate(PJRT_Client_Create_Args* args) {
+  // When $TFOS_MOCK_OPTIONS_DUMP is set, record the NamedValue create
+  // options the caller passed, one `name=typed-value` line each — lets the
+  // suite assert the runner's --create_option marshalling end-to-end
+  // (real plugins REQUIRE such options; axon rejects a bare create).
+  const char* odump = std::getenv("TFOS_MOCK_OPTIONS_DUMP");
+  if (odump != nullptr) {
+    std::ofstream f(odump);
+    for (size_t i = 0; i < args->num_options; ++i) {
+      const PJRT_NamedValue& nv = args->create_options[i];
+      f << std::string(nv.name, nv.name_size) << "=";
+      switch (nv.type) {
+        case PJRT_NamedValue_kString:
+          f << "str:" << std::string(nv.string_value, nv.value_size); break;
+        case PJRT_NamedValue_kInt64: f << "int:" << nv.int64_value; break;
+        case PJRT_NamedValue_kFloat: f << "float:" << nv.float_value; break;
+        case PJRT_NamedValue_kBool:
+          f << "bool:" << (nv.bool_value ? "true" : "false"); break;
+        default: f << "other"; break;
+      }
+      f << "\n";
+    }
+  }
   auto* client = new PJRT_Client;
   client->devices[0] = &client->device;
   args->client = client;

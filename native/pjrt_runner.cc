@@ -30,6 +30,7 @@
 #include <cstring>
 #include <fstream>
 #include <sstream>
+#include <stdexcept>
 #include <string>
 #include <vector>
 
@@ -146,11 +147,133 @@ InputSpec ParseInput(const std::string& arg) {
   return spec;
 }
 
+// Client-create option, parsed from a repeatable `--create_option key=value`
+// flag.  Production plugins reject a bare PJRT_Client_Create: libtpu wants
+// ml_framework_name etc., and proxying plugins need their routing options
+// (topology, session_id, ...).  Value typing: an explicit `int:`/`str:`/
+// `bool:`/`float:` prefix wins; otherwise all-digits (optional sign) is
+// kInt64, `true`/`false` is kBool, anything else a string.
+struct CreateOption {
+  std::string name;
+  PJRT_NamedValue_Type type;
+  std::string str;       // storage for kString
+  int64_t i64 = 0;
+  float f32 = 0.0f;
+  bool b = false;
+};
+
+bool AllDigits(const std::string& s) {
+  size_t start = (!s.empty() && (s[0] == '-' || s[0] == '+')) ? 1 : 0;
+  if (start >= s.size()) return false;
+  for (size_t i = start; i < s.size(); ++i)
+    if (s[i] < '0' || s[i] > '9') return false;
+  return true;
+}
+
+int64_t ParseI64OrDie(const std::string& val, const std::string& arg) {
+  try {
+    size_t used = 0;
+    int64_t v = std::stoll(val, &used);
+    if (used != val.size()) throw std::invalid_argument(val);
+    return v;
+  } catch (const std::exception&) {
+    Die("--create_option int value '" + val + "' is not a valid int64 in " +
+        arg);
+  }
+}
+
+float ParseF32OrDie(const std::string& val, const std::string& arg) {
+  try {
+    size_t used = 0;
+    float v = std::stof(val, &used);
+    if (used != val.size()) throw std::invalid_argument(val);
+    return v;
+  } catch (const std::exception&) {
+    Die("--create_option float value '" + val + "' is not a valid float in " +
+        arg);
+  }
+}
+
+CreateOption ParseCreateOption(const std::string& arg) {
+  size_t eq = arg.find('=');
+  if (eq == std::string::npos || eq == 0)
+    Die("--create_option wants key=value, got " + arg);
+  CreateOption opt;
+  opt.name = arg.substr(0, eq);
+  std::string val = arg.substr(eq + 1);
+  auto strip = [&](const char* prefix) {
+    size_t n = std::strlen(prefix);
+    if (val.compare(0, n, prefix) == 0) { val = val.substr(n); return true; }
+    return false;
+  };
+  if (strip("str:")) {
+    opt.type = PJRT_NamedValue_kString; opt.str = val;
+  } else if (strip("int:")) {
+    opt.type = PJRT_NamedValue_kInt64; opt.i64 = ParseI64OrDie(val, arg);
+  } else if (strip("bool:")) {
+    // explicit prefix promises typed parsing: reject anything but the
+    // canonical literals rather than coercing "True"/"yes" to false.
+    if (val == "true" || val == "1") { opt.b = true; }
+    else if (val == "false" || val == "0") { opt.b = false; }
+    else Die("--create_option bool value '" + val +
+             "' must be true/false/1/0 in " + arg);
+    opt.type = PJRT_NamedValue_kBool;
+  } else if (strip("float:")) {
+    opt.type = PJRT_NamedValue_kFloat; opt.f32 = ParseF32OrDie(val, arg);
+  } else if (AllDigits(val)) {
+    opt.type = PJRT_NamedValue_kInt64; opt.i64 = ParseI64OrDie(val, arg);
+  } else if (val == "true" || val == "false") {
+    opt.type = PJRT_NamedValue_kBool; opt.b = (val == "true");
+  } else {
+    opt.type = PJRT_NamedValue_kString; opt.str = val;
+  }
+  return opt;
+}
+
+// Build the PJRT_NamedValue array over stable CreateOption storage.
+std::vector<PJRT_NamedValue> ToNamedValues(
+    const std::vector<CreateOption>& opts) {
+  std::vector<PJRT_NamedValue> nvs;
+  nvs.reserve(opts.size());
+  for (const CreateOption& o : opts) {
+    PJRT_NamedValue nv;
+    std::memset(&nv, 0, sizeof(nv));
+    nv.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+    nv.extension_start = nullptr;
+    nv.name = o.name.c_str();
+    nv.name_size = o.name.size();
+    nv.type = o.type;
+    switch (o.type) {
+      case PJRT_NamedValue_kString:
+        nv.string_value = o.str.c_str();
+        nv.value_size = o.str.size();
+        break;
+      case PJRT_NamedValue_kInt64:
+        nv.int64_value = o.i64;
+        nv.value_size = 1;
+        break;
+      case PJRT_NamedValue_kFloat:
+        nv.float_value = o.f32;
+        nv.value_size = 1;
+        break;
+      case PJRT_NamedValue_kBool:
+        nv.bool_value = o.b;
+        nv.value_size = 1;
+        break;
+      default:
+        Die("unsupported create-option type");
+    }
+    nvs.push_back(nv);
+  }
+  return nvs;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
   std::string plugin_path, program_path, options_path, out_prefix = "out";
   std::vector<InputSpec> inputs;
+  std::vector<CreateOption> create_opts;
   // --batches N: each --input file carries N concatenated buffers of the
   // declared shape; the module compiles ONCE and executes N times (the
   // whole point of a serving runner — compilation is minutes on TPU,
@@ -166,6 +289,8 @@ int main(int argc, char** argv) {
     if (a == "--plugin") plugin_path = next("--plugin");
     else if (a == "--program") program_path = next("--program");
     else if (a == "--options") options_path = next("--options");
+    else if (a == "--create_option")
+      create_opts.push_back(ParseCreateOption(next("--create_option")));
     else if (a == "--input") inputs.push_back(ParseInput(next("--input")));
     else if (a == "--out") out_prefix = next("--out");
     else if (a == "--batches") {
@@ -192,9 +317,12 @@ int main(int argc, char** argv) {
   Check(api, api->PJRT_Plugin_Initialize(&init_args), "plugin init");
 
   // 2. Create the client and pick device 0.
+  std::vector<PJRT_NamedValue> nvs = ToNamedValues(create_opts);
   PJRT_Client_Create_Args cargs;
   std::memset(&cargs, 0, sizeof(cargs));
   cargs.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  cargs.create_options = nvs.empty() ? nullptr : nvs.data();
+  cargs.num_options = nvs.size();
   Check(api, api->PJRT_Client_Create(&cargs), "client create");
   PJRT_Client* client = cargs.client;
 

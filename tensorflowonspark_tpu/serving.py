@@ -21,6 +21,7 @@ framework-native equivalent over the export artifact
 """
 
 import logging
+import os
 
 import numpy as np
 
@@ -170,17 +171,57 @@ def serialize_embedded(model, params, input_signature, batch_size=128,
     return mlir, options, meta
 
 
+def plugin_create_options(plugin_path):
+    """Client-create NamedValue options for a PJRT plugin, as a list of
+    ``key=value`` strings for the runner's repeatable ``--create_option``.
+
+    Production plugins reject a bare ``PJRT_Client_Create`` — they need
+    platform options (the role TF_CONFIG-style session config played for
+    the reference's JVM serving path, TFModel.scala:245-292).  Resolution:
+
+    - ``TFOS_PJRT_CREATE_OPTIONS`` env (``;``-separated ``key=value``
+      pairs; a ``str:``/``int:``/``bool:``/``float:`` prefix on the value
+      forces its type) wins when set — the deployment escape hatch.
+    - A plugin whose basename mentions ``axon`` gets the proxy-plugin
+      option set its ``register()`` path requires: topology / session_id /
+      monoclient rank sentinel / remote_compile.
+    - Anything else (libtpu.so on a real TPU host): no options — libtpu
+      accepts a bare create.
+    """
+    env = os.environ.get("TFOS_PJRT_CREATE_OPTIONS")
+    if env is not None:
+        return [tok for tok in env.split(";") if tok]
+    if "axon" in os.path.basename(plugin_path or ""):
+        import uuid
+        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+        return [
+            "remote_compile=%d" % (
+                1 if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1"
+                else 0),
+            "local_only=0",
+            "priority=0",
+            "topology=str:%s:1x1x1" % gen,
+            "n_slices=1",
+            "session_id=str:%s" % uuid.uuid4(),
+            # monoclient rank sentinel (u32::MAX)
+            "rank=4294967295",
+        ]
+    return []
+
+
 def run_embedded_native(export_dir, feed, plugin_path, runner_path=None,
-                        workdir=None):
+                        workdir=None, create_options=None):
     """Serve one batch through the C++ PJRT runner (see
     :func:`run_embedded_native_many` — this is the single-batch wrapper)."""
     return run_embedded_native_many(export_dir, [feed], plugin_path,
                                     runner_path=runner_path,
-                                    workdir=workdir)[0]
+                                    workdir=workdir,
+                                    create_options=create_options)[0]
 
 
 def run_embedded_native_many(export_dir, feeds, plugin_path,
-                             runner_path=None, workdir=None):
+                             runner_path=None, workdir=None,
+                             create_options=None):
     """Serve MANY batches through ONE C++ PJRT runner invocation: the
     module compiles once and executes per batch (``--batches``), instead of
     paying plugin init + XLA compilation per batch — compilation is minutes
@@ -222,6 +263,10 @@ def run_embedded_native_many(export_dir, feeds, plugin_path,
            "--options", os.path.join(export_dir, emb["options_file"]),
            "--batches", str(n),
            "--out", os.path.join(workdir, "out")]
+    if create_options is None:
+        create_options = plugin_create_options(plugin_path)
+    for opt in create_options:
+        cmd += ["--create_option", opt]
     rev = {v: k for k, v in _SHORT_DTYPES.items()}
     for spec in emb["inputs"]:
         path = os.path.join(workdir, spec["name"] + ".bin")

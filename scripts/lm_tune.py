@@ -1,0 +1,151 @@
+"""Transformer-LM MFU tuning ladder: which config closes 33% -> 50%+?
+
+First on-chip transformer-LM capture (ROUND5.md session 3): the flagship
+leg (8 layers, d_model 1024, batch 8 x seq 1024, K=20) sustains 33.2% MFU
+at 114 ms/step while the same dispatch path runs plain matmuls at 82-87%
+of v5e peak.  The suspects are arithmetic-intensity edges, not dispatch
+(K=20 amortizes the ~70 ms RTT to <4 ms/step): d_model-1024 weights are
+small for the MXU, the attention inner matmuls have K=64 contraction dims,
+and layernorm/softmax/adam are HBM-bound elementwise passes whose relative
+cost shrinks as the matmuls grow.  Each variant below scales ONE axis of
+the baseline so the measured curve attributes the gap; each runs in a
+fresh subprocess (server-side compile state, XLA flags, and HBM all reset)
+and the aggregate JSON is rewritten after every variant so a tunnel flap
+keeps finished rows.
+
+Same measurement obligation as the reference's benchmark mode
+(reference examples/resnet/common.py:236-244) and the same timing
+discipline as scripts/k_ladder.py: every sample ends with a host readback
+data-dependent on the work (block_until_ready does not span the dispatch
+chain on remotely-attached backends).
+
+Usage:
+    python scripts/lm_tune.py                       # all variants
+    python scripts/lm_tune.py --variants baseline,wide
+    python scripts/lm_tune.py --one wide --out /tmp/x.json   # child mode
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# variant -> build_lm_trainer overrides (None = the bench leg's default)
+VARIANTS = {
+    "baseline": {},
+    # d_model 1024 -> 2048: 4x the per-layer matmul FLOPs at the same
+    # elementwise/dispatch cost -- the arithmetic-intensity lever
+    "wide": {"heads": 32},
+    # twice the layers at baseline width: scales FLOPs without changing
+    # matmul shapes -- separates "shapes too small" from "edges too thick"
+    "deep": {"layers": 16},
+    # 4x the token batch at baseline width: fattens EVERY matmul's
+    # non-contracted dim, incl. the K=64 attention inner products
+    "batch32": {"batch_size": 32},
+    # wide + fatter batch together (the presumptive flagship config)
+    "wide_b16": {"heads": 32, "batch_size": 16},
+    # longer sequences at constant tokens/batch: attention share grows
+    # (quadratic), feed-forward share constant -- prices the flash kernel
+    "seq4096": {"seq": 4096, "batch_size": 2},
+}
+
+
+def run_one(variant, k, repeats):
+    import jax
+
+    # The axon sitecustomize overrides jax_platforms to "axon,cpu" at
+    # interpreter start, which makes a JAX_PLATFORMS=cpu smoke run hang on
+    # the downed tunnel instead of using CPU.  Env wins (same restore the
+    # test conftest does).
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    from bench import build_lm_trainer
+    from tensorflowonspark_tpu import metrics as metrics_mod
+
+    trainer, batch, mask, config = build_lm_trainer(
+        log_steps=10 ** 9, **VARIANTS[variant])
+
+    t0 = time.perf_counter()
+    float(trainer.repeat_step(batch, mask, k))   # compile + warm
+    compile_s = time.perf_counter() - t0
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        final = trainer.repeat_step(batch, mask, k)
+        float(final)                             # readback: the real barrier
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    med = samples[len(samples) // 2]
+    ms_per_step = 1e3 * med / k
+    tokens = config["batch"] * config["seq"]
+    out = {"variant": variant, "k": k, "runs": repeats,
+           "config": config,
+           "compile_s": round(compile_s, 1),
+           "ms_per_step": round(ms_per_step, 2),
+           "min_ms_per_step": round(1e3 * samples[0] / k, 2),
+           "tokens_per_sec": round(tokens / (med / k), 0),
+           "device_kind": jax.devices()[0].device_kind}
+    flops = trainer.history.step_flops
+    peak = metrics_mod.peak_flops_per_device()
+    if flops and peak:
+        out["mfu_pct"] = round(100 * flops / peak / (med / k), 2)
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--variants", default=",".join(VARIANTS))
+    p.add_argument("--one", help="(child mode) run a single variant")
+    p.add_argument("--k", type=int, default=20)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--out", default="lm_tune.json")
+    p.add_argument("--timeout", type=int, default=900,
+                   help="per-variant child budget (compile is minutes-slow)")
+    args = p.parse_args()
+
+    if args.one:
+        row = run_one(args.one, args.k, args.repeats)
+        with open(args.out, "w") as f:
+            json.dump(row, f)
+        print(json.dumps(row))
+        return
+
+    results = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "k": args.k, "rows": []}
+    for variant in args.variants.split(","):
+        if variant not in VARIANTS:
+            print("unknown variant %s (have %s)"
+                  % (variant, ",".join(VARIANTS)), file=sys.stderr)
+            continue
+        child_out = args.out + "." + variant
+        cmd = [sys.executable, os.path.abspath(__file__), "--one", variant,
+               "--k", str(args.k), "--repeats", str(args.repeats),
+               "--out", child_out]
+        t0 = time.time()
+        try:
+            proc = subprocess.run(cmd, cwd=ROOT, timeout=args.timeout)
+            if proc.returncode == 0 and os.path.exists(child_out):
+                with open(child_out) as f:
+                    row = json.load(f)
+            else:
+                row = {"variant": variant,
+                       "error": "rc=%d" % proc.returncode}
+        except subprocess.TimeoutExpired:
+            row = {"variant": variant,
+                   "error": "timeout after %ds" % args.timeout}
+        row["elapsed_s"] = round(time.time() - t0, 1)
+        results["rows"].append(row)
+        # rewrite after EVERY variant: a flap mid-ladder keeps what ran
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

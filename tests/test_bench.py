@@ -82,3 +82,25 @@ def test_partial_evidence_drop(tmp_path):
     with open(partial / "transformer.json") as f:
         dropped = json.load(f)
     assert dropped["global_steps"] == 4
+
+
+def test_lm_tune_ladder_smoke(tmp_path):
+    """The lm_tune ladder (scripts/lm_tune.py) runs a variant end-to-end
+    on CPU and persists the aggregate JSON after each variant — the
+    contract bench_watch's window playbook relies on."""
+    out = str(tmp_path / "lm_tune.json")
+    env = dict(os.environ)
+    env.update(LM_SMOKE_ENV, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "lm_tune.py"),
+         "--variants", "baseline", "--k", "2", "--repeats", "1",
+         "--out", out],
+        cwd=ROOT, env=env, timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out) as f:
+        results = json.load(f)
+    (row,) = results["rows"]
+    assert row["variant"] == "baseline"
+    assert row["ms_per_step"] > 0
+    assert row["config"]["seq"] == 64  # env knobs reached the child
+    assert "mfu_pct" in row

@@ -196,8 +196,14 @@ def test_embedded_native_serving(tmp_path):
     out = serving_mod.run_embedded_native(
         export_dir, {"user": users, "item": items}, plugin)
     ref = model.apply({"params": params}, user=users, item=items)
+    # TPU MXU matmuls run bf16-input by default (jax default precision), so
+    # the device result differs from the host f32 reference at the bf16
+    # mantissa scale (~1e-2 relative) — a tight 1e-4 bound fails on real
+    # TPU hardware while passing on CPU plugins.  2e-2 still catches
+    # marshalling bugs (wrong buffer -> O(1) error), which is what this
+    # test guards.
     np.testing.assert_allclose(out["score"], np.asarray(ref["score"]),
-                               rtol=1e-4, atol=1e-4)
+                               rtol=2e-2, atol=2e-2)
 
 
 def test_cli_native_path_batches_and_zips(tmp_path, monkeypatch):

@@ -77,6 +77,7 @@ LM_SEQ = int(os.environ.get("TFOS_BENCH_LM_SEQ", 1024))
 LM_LAYERS = int(os.environ.get("TFOS_BENCH_LM_LAYERS", 8))
 LM_HEADS = int(os.environ.get("TFOS_BENCH_LM_HEADS", 16))
 LM_VOCAB = int(os.environ.get("TFOS_BENCH_LM_VOCAB", 32000))
+LM_ATTN = os.environ.get("TFOS_BENCH_LM_ATTN", "full")
 LM_STEPS = int(os.environ.get("TFOS_BENCH_LM_STEPS", 60))
 LM_STEPS_PER_CALL = int(os.environ.get("TFOS_BENCH_LM_SPC", 20))
 
@@ -258,7 +259,7 @@ def resnet_main(args, ctx):
 
 
 def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
-                     vocab=None, log_steps=20):
+                     vocab=None, attention=None, log_steps=20):
     """(trainer, batch, mask) for the transformer-LM leg on the current
     backend's mesh — the ONE place the flagship LM benchmark model is
     defined.  ``scripts/k_ladder.py`` measures the same construction, so
@@ -277,11 +278,13 @@ def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
     layers = LM_LAYERS if layers is None else layers
     heads = LM_HEADS if heads is None else heads
     vocab = LM_VOCAB if vocab is None else vocab
+    attention = LM_ATTN if attention is None else attention
 
     mesh = mesh_mod.build_mesh()
     model = transformer.build_transformer(
         vocab_size=vocab, num_layers=layers, num_heads=heads,
-        head_dim=64, max_seq_len=seq, dtype="bfloat16")
+        head_dim=64, max_seq_len=seq, attention=attention,
+        dtype="bfloat16")
     tokens = np.arange(batch_size * seq,
                        dtype=np.int32).reshape(batch_size, seq)
     tokens %= vocab
@@ -296,7 +299,7 @@ def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
     mask = jax.device_put(np.ones((batch_size,), np.float32),
                           mesh_mod.batch_sharding(mesh))
     config = {"batch": batch_size, "seq": seq, "layers": layers,
-              "heads": heads, "vocab": vocab}
+              "heads": heads, "vocab": vocab, "attention": attention}
     return trainer, batch, mask, config
 
 
@@ -640,7 +643,7 @@ def main():
         "transformer_lm_config": {
             "batch": LM_BATCH, "seq": LM_SEQ, "layers": LM_LAYERS,
             "heads": LM_HEADS, "vocab": LM_VOCAB,
-            "steps_per_call": LM_STEPS_PER_CALL},
+            "attention": LM_ATTN, "steps_per_call": LM_STEPS_PER_CALL},
     }
     if feedplane:
         out["feed_plane_images_per_sec"] = round(

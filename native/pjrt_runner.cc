@@ -13,6 +13,7 @@
 //   pjrt_run --plugin /lib/libtpu.so --program apply_embedded.mlir \
 //            --options compile_options.pb \
 //            --input f32:128,28,28,1:images.bin [--input ...] \
+//            [--create_option key=value ...] \
 //            --out /tmp/pred
 //
 // Inputs are dense row-major host buffers; order must match the module's

@@ -28,7 +28,6 @@ Usage:
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -52,6 +51,12 @@ VARIANTS = {
     # longer sequences at constant tokens/batch: attention share grows
     # (quadratic), feed-forward share constant -- prices the flash kernel
     "seq4096": {"seq": 4096, "batch_size": 2},
+    # pallas FlashAttention-2 instead of full causal attention: skips the
+    # masked half of the S^2 score work and never materializes the S x S
+    # matrix.  Compare on ms_per_step/tokens_per_sec, NOT mfu_pct -- the
+    # kernel is a custom call XLA's cost analysis can't see into, so its
+    # FLOPs vanish from the MFU numerator
+    "flash": {"attention": "flash"},
 }
 
 
@@ -116,35 +121,23 @@ def main():
         print(json.dumps(row))
         return
 
-    results = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-               "k": args.k, "rows": []}
+    import ladder
+
+    wanted = []
     for variant in args.variants.split(","):
         if variant not in VARIANTS:
             print("unknown variant %s (have %s)"
                   % (variant, ",".join(VARIANTS)), file=sys.stderr)
             continue
-        child_out = args.out + "." + variant
-        cmd = [sys.executable, os.path.abspath(__file__), "--one", variant,
-               "--k", str(args.k), "--repeats", str(args.repeats),
-               "--out", child_out]
-        t0 = time.time()
-        try:
-            proc = subprocess.run(cmd, cwd=ROOT, timeout=args.timeout)
-            if proc.returncode == 0 and os.path.exists(child_out):
-                with open(child_out) as f:
-                    row = json.load(f)
-            else:
-                row = {"variant": variant,
-                       "error": "rc=%d" % proc.returncode}
-        except subprocess.TimeoutExpired:
-            row = {"variant": variant,
-                   "error": "timeout after %ds" % args.timeout}
-        row["elapsed_s"] = round(time.time() - t0, 1)
-        results["rows"].append(row)
-        # rewrite after EVERY variant: a flap mid-ladder keeps what ran
-        with open(args.out, "w") as f:
-            json.dump(results, f, indent=1)
-        print(json.dumps(row), flush=True)
+        wanted.append(variant)
+    ladder.run_ladder(
+        wanted,
+        lambda v, child_out: [
+            sys.executable, os.path.abspath(__file__), "--one", v,
+            "--k", str(args.k), "--repeats", str(args.repeats),
+            "--out", child_out],
+        args.out, args.timeout, meta={"k": args.k}, cwd=ROOT,
+        label="lm_tune")
 
 
 if __name__ == "__main__":

@@ -35,7 +35,6 @@ Usage:
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -164,44 +163,24 @@ def main():
         print(json.dumps(stats))
         return
 
-    results = {"ts": time.time(), "batch": args.batch, "k": args.k,
-               "variants": {}}
-    for variant in args.variants.split(","):
-        child_out = args.out + "." + variant
+    import ladder
+
+    def env_for(variant):
         env = dict(os.environ)
         if variant in VARIANT_FLAGS:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " "
                                 + VARIANT_FLAGS[variant]).strip()
-        cmd = [sys.executable, os.path.abspath(__file__), "--one", variant,
-               "--batch", str(args.batch), "--k", str(args.k),
-               "--repeats", str(args.repeats), "--out", child_out]
-        print("[resnet_tune] %s ..." % variant, flush=True)
-        try:
-            proc = subprocess.run(cmd, cwd=ROOT, env=env,
-                                  timeout=args.timeout)
-            if proc.returncode == 0 and os.path.exists(child_out):
-                with open(child_out) as f:
-                    results["variants"][variant] = json.load(f)
-            else:
-                results["variants"][variant] = {
-                    "error": "rc=%d" % proc.returncode}
-        except subprocess.TimeoutExpired:
-            results["variants"][variant] = {
-                "error": "timeout after %ds" % args.timeout}
-        # persist after EVERY variant: a tunnel flap mid-ladder keeps the
-        # finished rows (bench_watch lesson)
-        with open(args.out, "w") as f:
-            json.dump(results, f, indent=1)
-        print("[resnet_tune] %s -> %s" % (
-            variant, json.dumps(results["variants"][variant])), flush=True)
-    base = results["variants"].get("baseline", {}).get("ms_per_step")
-    if base:
-        for name, row in results["variants"].items():
-            if row.get("ms_per_step"):
-                row["vs_baseline"] = round(base / row["ms_per_step"], 3)
-        with open(args.out, "w") as f:
-            json.dump(results, f, indent=1)
-    print("wrote", args.out)
+        return env
+
+    ladder.run_ladder(
+        [v for v in args.variants.split(",") if v],
+        lambda v, child_out: [
+            sys.executable, os.path.abspath(__file__), "--one", v,
+            "--batch", str(args.batch), "--k", str(args.k),
+            "--repeats", str(args.repeats), "--out", child_out],
+        args.out, args.timeout,
+        meta={"batch": args.batch, "k": args.k}, env_for=env_for,
+        cwd=ROOT, label="resnet_tune")
 
 
 if __name__ == "__main__":

@@ -18,6 +18,7 @@ Sync data parallelism — the reference's ``MultiWorkerMirroredStrategy`` path
 import dataclasses
 import logging
 import math
+import os
 
 logger = logging.getLogger(__name__)
 
@@ -71,6 +72,19 @@ def build_mesh(spec=None, devices=None, keep_trivial_axes=False):
     from jax.sharding import Mesh
 
     if devices is None:
+        # Env wins over plugin sitecustomize hooks that rewrite the
+        # jax_platforms CONFIG after registration (the axon PJRT shim sets
+        # "axon,cpu" at interpreter start): a JAX_PLATFORMS=cpu executor —
+        # CI, smoke runs, tests — must never touch (or hang on) a remote
+        # accelerator its environment explicitly deselected.  Only the
+        # PRIMARY platform is enforced: when env and config already agree
+        # on it, plugin-appended fallbacks (the "cpu" in "axon,cpu",
+        # needed for jax.debug.callback staging) are left alone.
+        env_platforms = os.environ.get("JAX_PLATFORMS")
+        cfg = jax.config.jax_platforms or ""
+        if (env_platforms
+                and cfg.split(",")[0] != env_platforms.split(",")[0]):
+            jax.config.update("jax_platforms", env_platforms)
         devices = jax.devices()
     if spec is None:
         spec = MeshSpec()

@@ -78,6 +78,8 @@ LM_LAYERS = int(os.environ.get("TFOS_BENCH_LM_LAYERS", 8))
 LM_HEADS = int(os.environ.get("TFOS_BENCH_LM_HEADS", 16))
 LM_VOCAB = int(os.environ.get("TFOS_BENCH_LM_VOCAB", 32000))
 LM_ATTN = os.environ.get("TFOS_BENCH_LM_ATTN", "full")
+LM_MLP = os.environ.get("TFOS_BENCH_LM_MLP", "dense")
+LM_EXPERTS = int(os.environ.get("TFOS_BENCH_LM_EXPERTS", 8))
 LM_STEPS = int(os.environ.get("TFOS_BENCH_LM_STEPS", 60))
 LM_STEPS_PER_CALL = int(os.environ.get("TFOS_BENCH_LM_SPC", 20))
 
@@ -259,7 +261,8 @@ def resnet_main(args, ctx):
 
 
 def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
-                     vocab=None, attention=None, log_steps=20):
+                     vocab=None, attention=None, mlp=None, num_experts=None,
+                     log_steps=20):
     """(trainer, batch, mask) for the transformer-LM leg on the current
     backend's mesh — the ONE place the flagship LM benchmark model is
     defined.  ``scripts/k_ladder.py`` measures the same construction, so
@@ -279,12 +282,14 @@ def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
     heads = LM_HEADS if heads is None else heads
     vocab = LM_VOCAB if vocab is None else vocab
     attention = LM_ATTN if attention is None else attention
+    mlp = LM_MLP if mlp is None else mlp
+    num_experts = LM_EXPERTS if num_experts is None else num_experts
 
     mesh = mesh_mod.build_mesh()
     model = transformer.build_transformer(
         vocab_size=vocab, num_layers=layers, num_heads=heads,
         head_dim=64, max_seq_len=seq, attention=attention,
-        dtype="bfloat16")
+        mlp=mlp, num_experts=num_experts, dtype="bfloat16")
     tokens = np.arange(batch_size * seq,
                        dtype=np.int32).reshape(batch_size, seq)
     tokens %= vocab
@@ -299,7 +304,10 @@ def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
     mask = jax.device_put(np.ones((batch_size,), np.float32),
                           mesh_mod.batch_sharding(mesh))
     config = {"batch": batch_size, "seq": seq, "layers": layers,
-              "heads": heads, "vocab": vocab, "attention": attention}
+              "heads": heads, "vocab": vocab, "attention": attention,
+              "mlp": mlp}
+    if mlp == "moe":
+        config["num_experts"] = num_experts
     return trainer, batch, mask, config
 
 
@@ -640,10 +648,12 @@ def main():
         if lm and lm.get("mfu") is not None else None,
         "transformer_lm_step_time_ms": round(
             1000 * lm["avg_step_seconds"], 2) if lm else None,
-        "transformer_lm_config": {
-            "batch": LM_BATCH, "seq": LM_SEQ, "layers": LM_LAYERS,
-            "heads": LM_HEADS, "vocab": LM_VOCAB,
-            "attention": LM_ATTN, "steps_per_call": LM_STEPS_PER_CALL},
+        "transformer_lm_config": dict(
+            {"batch": LM_BATCH, "seq": LM_SEQ, "layers": LM_LAYERS,
+             "heads": LM_HEADS, "vocab": LM_VOCAB,
+             "attention": LM_ATTN, "mlp": LM_MLP,
+             "steps_per_call": LM_STEPS_PER_CALL},
+            **({"num_experts": LM_EXPERTS} if LM_MLP == "moe" else {})),
     }
     if feedplane:
         out["feed_plane_images_per_sec"] = round(

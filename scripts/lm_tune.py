@@ -57,18 +57,15 @@ VARIANTS = {
     # kernel is a custom call XLA's cost analysis can't see into, so its
     # FLOPs vanish from the MFU numerator
     "flash": {"attention": "flash"},
+    # top-k gated MoE FFN (8 experts, GSPMD layer; experts local on one
+    # chip): what the grouped expert einsums cost vs the dense MLP --
+    # the on-chip half of the EP story the CPU-mesh suite can't price
+    "moe": {"mlp": "moe"},
 }
 
 
 def run_one(variant, k, repeats):
     import jax
-
-    # The axon sitecustomize overrides jax_platforms to "axon,cpu" at
-    # interpreter start, which makes a JAX_PLATFORMS=cpu smoke run hang on
-    # the downed tunnel instead of using CPU.  Env wins (same restore the
-    # test conftest does).
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     from bench import build_lm_trainer
     from tensorflowonspark_tpu import metrics as metrics_mod

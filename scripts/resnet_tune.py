@@ -57,11 +57,6 @@ def run_one(variant, batch_size, k, repeats):
     import jax.numpy as jnp
     import optax
 
-    # env wins over the axon sitecustomize's jax_platforms override (a
-    # JAX_PLATFORMS=cpu smoke run must not hang on a downed tunnel)
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
     from tensorflowonspark_tpu import metrics as metrics_mod
     from tensorflowonspark_tpu import train as train_mod
     from tensorflowonspark_tpu.models import resnet as resnet_mod

@@ -112,6 +112,11 @@ class TPUNodeContext(object):
         coordinates the rendezvous distributed (SURVEY §2.5).  No-op for
         single-process clusters and for ps nodes.
         """
+        from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+        # before ANY backend touch: env platform selection must win over
+        # plugin sitecustomize config rewrites (see enforce_env_platforms)
+        mesh_mod.enforce_env_platforms()
         if self.process_id is None or self.num_processes <= 1:
             return
         import jax

@@ -58,6 +58,44 @@ class MeshSpec:
         return sizes
 
 
+def enforce_env_platforms():
+    """Make the ``JAX_PLATFORMS`` env's PRIMARY platform win over plugin
+    sitecustomize hooks that rewrite the ``jax_platforms`` CONFIG after
+    registration (the axon PJRT shim sets ``"axon,cpu"`` at interpreter
+    start): a ``JAX_PLATFORMS=cpu`` executor — CI, smoke runs, tests —
+    must never touch (or hang on) a remote accelerator its environment
+    explicitly deselected.
+
+    Only the primary platform is enforced: when env and config already
+    agree on it, plugin-appended fallbacks (the ``"cpu"`` in
+    ``"axon,cpu"``, needed for ``jax.debug.callback`` staging) are left
+    alone.  JAX reads ``jax_platforms`` once at backend initialization
+    and caches backends, so this must run BEFORE the process's first
+    device op — every framework entry point that touches devices
+    (:func:`build_mesh`, ``TFNodeContext.initialize_distributed``) calls
+    it; a too-late call logs instead of silently not working.
+    """
+    import jax
+
+    env = os.environ.get("JAX_PLATFORMS")
+    if not env:
+        return
+    cfg = jax.config.jax_platforms or ""
+    if cfg.split(",")[0] == env.split(",")[0]:
+        return
+    try:
+        from jax._src import xla_bridge
+        initialized = bool(xla_bridge._backends)
+    except Exception:
+        initialized = False
+    if initialized:
+        logger.warning(
+            "JAX_PLATFORMS=%s cannot take effect: backends already "
+            "initialized under jax_platforms=%r", env, cfg)
+        return
+    jax.config.update("jax_platforms", env)
+
+
 def build_mesh(spec=None, devices=None, keep_trivial_axes=False):
     """Build a ``jax.sharding.Mesh`` over all devices of the jax world.
 
@@ -72,19 +110,7 @@ def build_mesh(spec=None, devices=None, keep_trivial_axes=False):
     from jax.sharding import Mesh
 
     if devices is None:
-        # Env wins over plugin sitecustomize hooks that rewrite the
-        # jax_platforms CONFIG after registration (the axon PJRT shim sets
-        # "axon,cpu" at interpreter start): a JAX_PLATFORMS=cpu executor —
-        # CI, smoke runs, tests — must never touch (or hang on) a remote
-        # accelerator its environment explicitly deselected.  Only the
-        # PRIMARY platform is enforced: when env and config already agree
-        # on it, plugin-appended fallbacks (the "cpu" in "axon,cpu",
-        # needed for jax.debug.callback staging) are left alone.
-        env_platforms = os.environ.get("JAX_PLATFORMS")
-        cfg = jax.config.jax_platforms or ""
-        if (env_platforms
-                and cfg.split(",")[0] != env_platforms.split(",")[0]):
-            jax.config.update("jax_platforms", env_platforms)
+        enforce_env_platforms()
         devices = jax.devices()
     if spec is None:
         spec = MeshSpec()

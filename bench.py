@@ -186,7 +186,8 @@ def mnist_main(args, ctx):
     return stats
 
 
-def _run_synthetic_leg(trainer, batch, mask, k, steps, stats_path, chief):
+def _run_synthetic_leg(trainer, batch, mask, k, steps, stats_path, chief,
+                       extra=None):
     """Warm up, measure ``steps`` over one device-resident batch (the
     reference's benchmark mode, ``common.py:315-363``), write stats.
 
@@ -213,6 +214,8 @@ def _run_synthetic_leg(trainer, batch, mask, k, steps, stats_path, chief):
     stats = trainer.history.build_stats(loss=float(loss))
     stats["n_devices"] = len(jax.devices())
     stats["device_kind"] = jax.devices()[0].device_kind
+    if extra:
+        stats.update(extra)
     if chief:
         with open(stats_path, "w") as f:
             json.dump(stats, f, default=float)
@@ -316,12 +319,17 @@ def transformer_main(args, ctx):
     one synthetic device-resident token batch (the reference's benchmark
     mode shape, ``common.py:315-363``), K steps per dispatch."""
     ctx.initialize_distributed()
-    trainer, batch, mask, _ = build_lm_trainer(
+    trainer, batch, mask, config = build_lm_trainer(
         batch_size=args.batch_size, seq=args.seq, layers=args.layers,
         heads=args.heads, vocab=args.vocab)
+    # the leg's stats carry the EXACT config build_lm_trainer resolved
+    # (env knobs included) so the published transformer_lm_config can
+    # never drift from what actually ran
     return _run_synthetic_leg(
         trainer, batch, mask, args.steps_per_call, args.steps,
-        args.stats_path, ctx.is_chief())
+        args.stats_path, ctx.is_chief(),
+        extra={"config": dict(config,
+                              steps_per_call=args.steps_per_call)})
 
 
 # ---------------------------------------------------------------------------
@@ -648,12 +656,9 @@ def main():
         if lm and lm.get("mfu") is not None else None,
         "transformer_lm_step_time_ms": round(
             1000 * lm["avg_step_seconds"], 2) if lm else None,
-        "transformer_lm_config": dict(
-            {"batch": LM_BATCH, "seq": LM_SEQ, "layers": LM_LAYERS,
-             "heads": LM_HEADS, "vocab": LM_VOCAB,
-             "attention": LM_ATTN, "mlp": LM_MLP,
-             "steps_per_call": LM_STEPS_PER_CALL},
-            **({"num_experts": LM_EXPERTS} if LM_MLP == "moe" else {})),
+        # the config the leg itself recorded (build_lm_trainer is the one
+        # source of truth); None when the leg didn't run
+        "transformer_lm_config": lm.get("config") if lm else None,
     }
     if feedplane:
         out["feed_plane_images_per_sec"] = round(

@@ -30,6 +30,30 @@ def get_devices():
     return jax.devices()
 
 
+def is_tpu_device(device=None):
+    """True when ``device`` (default: the default device) is real TPU
+    silicon, whatever backend name it registered under.
+
+    The platform NAME is not a reliable signal: TPU-proxying PJRT
+    plugins register their own platform (the axon shim's backend is
+    ``"axon"`` with device_kind ``"TPU v5 lite"``) while lowering
+    Mosaic/StableHLO exactly like native libtpu.  Everything that gates
+    on "is this a TPU" — pallas interpret-mode fallbacks
+    (``ops.flash_attention``), StableHLO platform checks
+    (``serving.ModelServer``) — must key on this, not on
+    ``jax.default_backend()``.
+    """
+    import jax
+
+    if device is None:
+        devices = jax.devices()
+        if not devices:
+            return False
+        device = devices[0]
+    kind = getattr(device, "device_kind", "") or ""
+    return ("tpu" in device.platform.lower()) or ("tpu" in kind.lower())
+
+
 def device_summary():
     """Human-readable device roster for lifecycle logs."""
     import jax

@@ -32,7 +32,14 @@ NEG_INF = -1e30
 
 
 def _default_interpret():
-    return jax.default_backend() != "tpu"
+    """Interpret (pure-JAX emulation) unless the default device is real
+    TPU silicon — string-matching ``default_backend() != "tpu"`` would
+    silently interpret-mode the kernel on TPU-proxying plugins (axon),
+    turning the hot-path attention into a ~1000x-slow emulation with no
+    error.  See :func:`device_info.is_tpu_device`."""
+    from tensorflowonspark_tpu.device_info import is_tpu_device
+
+    return not is_tpu_device()
 
 
 # ---------------------------------------------------------------------------

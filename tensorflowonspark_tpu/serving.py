@@ -367,6 +367,13 @@ class ModelServer(object):
         platform = jax.default_backend()
         platforms = [p.lower() for p in hlo.get("platforms", [])]
         if platforms and platform not in platforms:
+            # TPU-proxying PJRT plugins register their own backend name
+            # but execute tpu-lowered modules (device_info.is_tpu_device)
+            from tensorflowonspark_tpu.device_info import is_tpu_device
+
+            if "tpu" in platforms and is_tpu_device():
+                platform = "tpu"
+        if platforms and platform not in platforms:
             logger.warning(
                 "stablehlo artifact lowered for %s but host platform is %s; "
                 "falling back to registry serving", platforms, platform)

@@ -518,3 +518,23 @@ def test_shutdown_remote_unreachable_is_warning_not_fatal():
     finally:
         for h in handles:
             h.shutdown()
+
+
+def test_is_tpu_device_keys_on_silicon_not_backend_name():
+    """TPU-proxying plugins (axon) register their own platform name but
+    present TPU device_kind; CPU must stay non-TPU.  Everything gating on
+    'is this a TPU' (pallas interpret fallback, StableHLO platform remap)
+    relies on this classification."""
+    from tensorflowonspark_tpu import device_info
+
+    class FakeDev:
+        def __init__(self, platform, kind):
+            self.platform = platform
+            self.device_kind = kind
+
+    assert device_info.is_tpu_device(FakeDev("tpu", "TPU v5e"))
+    assert device_info.is_tpu_device(FakeDev("axon", "TPU v5 lite"))
+    assert not device_info.is_tpu_device(FakeDev("cpu", "cpu"))
+    assert not device_info.is_tpu_device(FakeDev("gpu", "NVIDIA H100"))
+    # the default device on this CPU test host is not TPU
+    assert not device_info.is_tpu_device()

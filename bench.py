@@ -288,20 +288,33 @@ def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
     mlp = LM_MLP if mlp is None else mlp
     num_experts = LM_EXPERTS if num_experts is None else num_experts
 
+    head_dim = 64
     mesh = mesh_mod.build_mesh()
     model = transformer.build_transformer(
         vocab_size=vocab, num_layers=layers, num_heads=heads,
-        head_dim=64, max_seq_len=seq, attention=attention,
+        head_dim=head_dim, max_seq_len=seq, attention=attention,
         mlp=mlp, num_experts=num_experts, dtype="bfloat16")
     tokens = np.arange(batch_size * seq,
                        dtype=np.int32).reshape(batch_size, seq)
     tokens %= vocab
     params = model.init(jax.random.PRNGKey(0),
                         jnp.asarray(tokens[:1]))["params"]
+    # The pallas flash kernel is a custom call XLA's cost analysis scores
+    # at zero FLOPs, so its attention work must be added analytically or
+    # the MFU numerator drops exactly the FLOPs the kernel saves time on.
+    # Per (batch, head), causal training ≈ 7·S²·D flops: fwd = 2 matmuls
+    # = 4·S²·D non-causal → 2·S²·D causal; bwd = 5 matmuls (recompute qk,
+    # dV, dP, dQ, dK) = 10·S²·D non-causal → 5·S²·D causal.  Divided by
+    # the device count to match estimate_step_flops's per-device (post-
+    # SPMD-partitioning) convention under batch sharding.
+    extra_flops = 0
+    if attention == "flash":
+        extra_flops = (7 * seq * seq * head_dim * batch_size * heads
+                       * layers // max(len(jax.devices()), 1))
     trainer = train_mod.Trainer(
         transformer.loss_fn(model), params, optax.adam(1e-3), mesh=mesh,
         compute_dtype=jnp.bfloat16, batch_size=batch_size,
-        log_steps=log_steps)
+        log_steps=log_steps, extra_step_flops=extra_flops)
     sharding = mesh_mod.batch_sharding(mesh, extra_dims=1)
     batch = {"tokens": jax.device_put(jnp.asarray(tokens), sharding)}
     mask = jax.device_put(np.ones((batch_size,), np.float32),

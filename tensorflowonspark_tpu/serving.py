@@ -337,15 +337,22 @@ class ModelServer(object):
             self._predict = jax.jit(exported.call)
             self.from_stablehlo = True
         else:
-            from tensorflowonspark_tpu.models import get_model
-
-            model = get_model(desc["model_name"],
-                              **desc.get("model_config", {}))
-            self._predict = jax.jit(build_apply_fn(model, self.signature))
+            self._predict = self._registry_predict()
         logger.info("loaded model %s from %s (inputs: %s, stablehlo: %s)",
                     desc["model_name"], export_dir,
                     sorted(self.signature) or "<unnamed>",
                     self.from_stablehlo)
+
+    def _registry_predict(self):
+        """Rebuild the apply fn from the model registry (the no-artifact
+        fallback path)."""
+        import jax
+
+        from tensorflowonspark_tpu.models import get_model
+
+        model = get_model(self.descriptor["model_name"],
+                          **self.descriptor.get("model_config", {}))
+        return jax.jit(build_apply_fn(model, self.signature))
 
     @staticmethod
     def _load_stablehlo(export_dir, desc):
@@ -480,7 +487,23 @@ class ModelServer(object):
                 return np.pad(x, width)
 
             feed = {k: pad(v) for k, v in feed.items()}
-        out = self._predict(self.params, feed)
+        try:
+            out = self._predict(self.params, feed)
+        except Exception:
+            if not self.from_stablehlo:
+                raise
+            # jax.export enforces its own lowering-platform check at first
+            # call — a proxying backend whose name isn't in the artifact's
+            # platform list (axon vs "tpu") can pass _load_stablehlo's
+            # remap yet still be refused here.  Degrade to registry
+            # serving (the pre-artifact behavior) instead of failing the
+            # whole server; first-call-only, the swap is sticky.
+            logger.warning(
+                "stablehlo artifact unusable on this backend; falling "
+                "back to registry serving", exc_info=True)
+            self.from_stablehlo = False
+            self._predict = self._registry_predict()
+            out = self._predict(self.params, feed)
         return {k: np.asarray(v)[:count] for k, v in _name_outputs(out).items()}
 
     def run_rows(self, iterator, input_mapping=None, output_mapping=None):

@@ -97,7 +97,8 @@ class Trainer(object):
     def __init__(self, loss_fn, init_params, optimizer, mesh=None,
                  extra_state=None, compute_dtype=None, batch_size=None,
                  log_steps=20, donate=True, accum_steps=1,
-                 summary_writer=None, param_sharding=None):
+                 summary_writer=None, param_sharding=None,
+                 extra_step_flops=0):
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh()
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -108,6 +109,15 @@ class Trainer(object):
         # optional summary.SummaryWriter: window scalars -> TensorBoard
         # (create it on the chief only; see checkpoint.should_export)
         self.summary_writer = summary_writer
+        # Per-device FLOPs/step XLA's cost analysis cannot see — pallas
+        # kernels are custom calls with no cost model, so a flash-attention
+        # model's attention work would otherwise vanish from the MFU
+        # numerator (making the fused kernel look SLOWER per "reported"
+        # FLOP than the naive path it beats).  The model owner computes
+        # the analytic figure (e.g. bench.build_lm_trainer for the LM
+        # legs) and passes it here; added to the cost-analysis estimate
+        # when TimeHistory is built.
+        self.extra_step_flops = extra_step_flops
         self._has_extra = extra_state is not None
 
         self.state = TrainState(
@@ -300,6 +310,13 @@ class Trainer(object):
             flops = metrics_mod.estimate_step_flops(
                 jax.jit(self._plain_core), self.state,
                 example_batch, example_mask)
+            # only supplement a SUCCESSFUL base estimate: when cost
+            # analysis is unavailable (returns None) the supplement alone
+            # would publish a confidently tiny MFU with the matmul work
+            # missing from the numerator — None (honestly unknown) is the
+            # right answer there
+            if self.extra_step_flops and flops:
+                flops = flops + self.extra_step_flops
             self.history = metrics_mod.TimeHistory(
                 batch_size=self.batch_size or 0, log_steps=self.log_steps,
                 step_flops=flops, summary_writer=self.summary_writer)

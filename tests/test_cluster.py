@@ -536,5 +536,9 @@ def test_is_tpu_device_keys_on_silicon_not_backend_name():
     assert device_info.is_tpu_device(FakeDev("axon", "TPU v5 lite"))
     assert not device_info.is_tpu_device(FakeDev("cpu", "cpu"))
     assert not device_info.is_tpu_device(FakeDev("gpu", "NVIDIA H100"))
-    # the default device on this CPU test host is not TPU
-    assert not device_info.is_tpu_device()
+    # no-arg form inspects the default device; only pin the expectation
+    # when the suite is actually on CPU (it is under conftest, but a
+    # bare on-device run must not fail the classification working)
+    import jax
+    if jax.default_backend() == "cpu":
+        assert not device_info.is_tpu_device()

@@ -80,6 +80,22 @@ class TestMetrics:
         flops = metrics_mod.estimate_step_flops(f, x, x)
         assert flops and flops >= 2 * 64 * 64 * 64 * 0.9
 
+    def test_extra_step_flops_added_to_history(self):
+        # pallas kernels are custom calls XLA costs at zero FLOPs; the
+        # model owner's analytic supplement must land in the MFU numerator
+        mesh = build_mesh()
+        params = {"w": jnp.zeros((2,)), "b": jnp.zeros(())}
+        base = Trainer(_linear_loss, params, optax.sgd(0.1), mesh=mesh,
+                       batch_size=4)
+        boosted = Trainer(_linear_loss, params, optax.sgd(0.1), mesh=mesh,
+                          batch_size=4, extra_step_flops=12345.0)
+        batch = {"x": jnp.ones((4, 2)), "y": jnp.ones((4,))}
+        mask = jnp.ones((4,))
+        base.step(batch, mask)
+        boosted.step(batch, mask)
+        assert boosted.history.step_flops == (base.history.step_flops
+                                              or 0.0) + 12345.0
+
     def test_peak_flops_exact_match_no_prefix_swallow(self):
         # "tpu v5" must not swallow "tpu v5 lite"/"tpu v5p" (2.3x MFU error)
         assert metrics_mod.PEAK_FLOPS["tpu v5 lite"] == 197e12

@@ -40,6 +40,7 @@ field) when the ceiling leg failed — a failed baseline must not read as
 """
 
 import argparse
+import calendar
 import json
 import os
 import subprocess
@@ -530,11 +531,25 @@ def _leg_subprocess(leg, out_path):
     env = dict(os.environ)
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
                    os.path.join(root, ".jax_cache"))
-    return subprocess.run(
-        [sys.executable, os.path.abspath(__file__), "--leg", leg,
-         "--out", out_path],
-        cwd=root, env=env,
-        timeout=LEG_TIMEOUT_SECS[leg])
+    # The child prints its stats to ITS stdout (so a bare `--leg` run can
+    # never lose a measurement to a forgotten --out) — but the parent's
+    # stdout is the ONE graded JSON line, so the child's must be captured
+    # and relayed to stderr, never inherited.  Captured via a temp FILE,
+    # not a pipe: the legs fork executor/manager grandchildren that
+    # inherit fd 1, and a lingering orphan holding a pipe open would make
+    # run() block until the full leg timeout after the child already
+    # exited cleanly.
+    with tempfile.TemporaryFile(mode="w+") as cap:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--leg", leg,
+             "--out", out_path],
+            cwd=root, env=env, stdout=cap,
+            timeout=LEG_TIMEOUT_SECS[leg])
+        cap.seek(0)
+        relay = cap.read()
+    if relay:
+        sys.stderr.write(relay)
+    return proc
 
 
 def probe_device(timeout=150, attempts=3, retry_sleep=120):
@@ -579,6 +594,11 @@ def run_leg_isolated(leg, retries=1):
     tunnel flap) still keeps the evidence of every leg that finished."""
     err = None
     partial_dir = os.environ.get("TFOS_BENCH_PARTIAL_DIR")
+    explicit_dir = partial_dir is not None
+    if not explicit_dir:
+        # the env-less driver run writes evidence too (a later tunnel-down
+        # re-run must replay the FRESHEST capture, not just the watcher's)
+        partial_dir = DEFAULT_PARTIAL_DIR
     for attempt in range(retries + 1):
         out_path = os.path.join(tempfile.mkdtemp(), leg + ".json")
         try:
@@ -586,12 +606,32 @@ def run_leg_isolated(leg, retries=1):
             if proc.returncode == 0 and os.path.exists(out_path):
                 with open(out_path) as f:
                     stats = json.load(f)
-                if partial_dir:
+                # Default-dir drops additionally require TPU silicon: a
+                # `JAX_PLATFORMS=cpu python bench.py` smoke run must never
+                # overwrite committed chip evidence with CPU numbers.  An
+                # explicit TFOS_BENCH_PARTIAL_DIR means the caller owns
+                # the destination (tests point it at tmp dirs).
+                is_device_leg = leg in ("mnist", "resnet", "transformer")
+                drop_ok = explicit_dir or (
+                    is_device_leg
+                    and "TPU" in str(stats.get("device_kind", "")))
+                if partial_dir and drop_ok:
                     try:
                         os.makedirs(partial_dir, exist_ok=True)
-                        with open(os.path.join(
-                                partial_dir, leg + ".json"), "w") as f:
-                            json.dump(stats, f)
+                        # stamp capture time + the config that produced the
+                        # numbers INTO the evidence (a later replay must not
+                        # misattribute them to whatever the constants say
+                        # then), and write atomically so a supervisor kill
+                        # mid-write can't destroy earlier good evidence
+                        dropped = dict(stats)
+                        dropped.setdefault("config", _leg_config(leg))
+                        dropped["captured_utc"] = time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                        final = os.path.join(partial_dir, leg + ".json")
+                        tmp = final + ".tmp.%d" % os.getpid()
+                        with open(tmp, "w") as f:
+                            json.dump(dropped, f)
+                        os.replace(tmp, final)
                     except OSError:
                         pass  # evidence drop is best-effort
                 return stats, None
@@ -607,6 +647,73 @@ def run_leg_isolated(leg, retries=1):
         if attempt < retries:
             time.sleep(60)  # a tunnel flap needs a pause, not an instant retry
     return None, err
+
+
+def _leg_config(leg):
+    """The module-constant config a device leg runs with, in the same
+    shape ``main`` publishes it — stamped into the evidence drop so a
+    replay can't pair old numbers with newer constants."""
+    if leg == "resnet":
+        return {"batch": RESNET_BATCH, "steps_per_call": RESNET_STEPS_PER_CALL,
+                "stem": RESNET_STEM,
+                "blocks_per_stage_override": RESNET_BLOCKS}
+    if leg == "mnist":
+        return {"batch": MNIST_BATCH, "steps_per_call": MNIST_STEPS_PER_CALL,
+                "epochs": MNIST_EPOCHS, "rows": MNIST_ROWS}
+    return None
+
+
+# Replayed evidence older than this is refused: the replay exists to carry
+# THIS round's tunnel-window captures to the round-end bench run, not to
+# leak a previous round's numbers into a new round's artifact.
+REPLAY_MAX_AGE_HOURS = float(
+    os.environ.get("TFOS_BENCH_REPLAY_MAX_AGE_HOURS", 48))
+
+# The one place the per-leg evidence directory is defined: the watcher
+# (scripts/bench_watch.py) points its bench children here via
+# TFOS_BENCH_PARTIAL_DIR, and an env-less `python bench.py` (the driver's
+# round-end run) reads the same path back for replay.
+DEFAULT_PARTIAL_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".bench_watch", "legs")
+
+
+def load_partial_leg(leg):
+    """Per-leg evidence captured by an EARLIER bench run this round.
+
+    ``run_leg_isolated`` drops every completed leg's stats into
+    ``TFOS_BENCH_PARTIAL_DIR`` (bench_watch points it at
+    ``.bench_watch/legs/``); when unset, the read side defaults to that
+    same directory so the driver's round-end ``python bench.py`` — which
+    sets no env — still inherits what the watcher captured during a
+    tunnel window instead of publishing nulls.  Evidence without an
+    embedded ``captured_utc`` stamp, or older than
+    ``REPLAY_MAX_AGE_HOURS``, is refused.  Returns
+    ``(stats, captured_utc)`` or ``(None, None)``.
+    """
+    partial_dir = (os.environ.get("TFOS_BENCH_PARTIAL_DIR")
+                   or DEFAULT_PARTIAL_DIR)
+    path = os.path.join(partial_dir, leg + ".json")
+    try:
+        with open(path) as f:
+            stats = json.load(f)
+        captured = stats.get("captured_utc")
+        if not captured:
+            # unstamped evidence has no trustworthy age — file mtime is
+            # reset by git checkout, which is exactly how a previous
+            # round's numbers would sneak past the staleness guard
+            print("bench: refusing unstamped {} evidence at {}".format(
+                leg, path), file=sys.stderr)
+            return None, None
+        age = time.time() - calendar.timegm(
+            time.strptime(captured, "%Y-%m-%dT%H:%M:%SZ"))
+        if age > REPLAY_MAX_AGE_HOURS * 3600:
+            print("bench: refusing stale {} evidence (captured {}, "
+                  "max age {}h)".format(leg, captured, REPLAY_MAX_AGE_HOURS),
+                  file=sys.stderr)
+            return None, None
+        return stats, captured
+    except (OSError, ValueError):
+        return None, None
 
 
 def main():
@@ -633,6 +740,24 @@ def main():
         # supervisor's umbrella time.
         lm, lm_err = run_leg_isolated("transformer")
 
+    # A device leg that produced nothing THIS run (tunnel down or flapped)
+    # falls back to evidence an earlier run captured during a live window
+    # (the watcher's .bench_watch/legs/).  Replayed legs are labeled with
+    # their capture time in `replayed_legs` so a fresh number and a
+    # replayed one can never be confused — and the watcher refuses to
+    # count a replayed bench as "captured" (bench_watch.bench_done).  The
+    # live run's failure reason stays in the *_error field: the reader
+    # needs both "here is the round's measured number" and "here is why
+    # this particular run couldn't measure".
+    replayed = {}
+    legs = {"mnist": mnist, "resnet": resnet, "transformer": lm}
+    for name in legs:
+        if legs[name] is None:
+            stats, ts = load_partial_leg(name)
+            if stats is not None:
+                legs[name], replayed[name] = stats, ts
+    mnist, resnet, lm = legs["mnist"], legs["resnet"], legs["transformer"]
+
     out = {
         # Compute headline: the MFU target lives on ResNet-50 (BASELINE.md).
         "metric": "resnet50_train_mfu",
@@ -654,15 +779,14 @@ def main():
         "feed_plane_images_per_sec": None,
         "feed_plane_vs_baseline": None,
         "device_kind": (resnet or mnist or {}).get("device_kind") or kind,
-        # measurement config (self-describing artifact)
-        "resnet50_config": {"batch": RESNET_BATCH, "steps_per_call":
-                            RESNET_STEPS_PER_CALL, "stem": RESNET_STEM,
-                            # 0 = the real [3,4,6,3] ResNet-50; anything
-                            # else marks this line as a shrunk smoke run
-                            "blocks_per_stage_override": RESNET_BLOCKS},
-        "mnist_config": {"batch": MNIST_BATCH, "steps_per_call":
-                         MNIST_STEPS_PER_CALL, "epochs": MNIST_EPOCHS,
-                         "rows": MNIST_ROWS},
+        # measurement config (self-describing artifact): a replayed leg's
+        # stats carry the config that produced them (stamped at drop
+        # time); fresh runs fall back to the module constants they ran
+        # with — 0 blocks_per_stage_override = the real [3,4,6,3]
+        # ResNet-50, anything else marks a shrunk smoke run
+        "resnet50_config": (resnet or {}).get("config")
+        or _leg_config("resnet"),
+        "mnist_config": (mnist or {}).get("config") or _leg_config("mnist"),
         # MXU-friendly flagship (beyond-baseline evidence): what MFU the
         # Trainer path sustains when the op mix is matmul-shaped.
         "transformer_lm_train_mfu": round(lm["mfu"], 4)
@@ -707,6 +831,8 @@ def main():
                       ("ceiling_error", ceiling_err)):
         if err:
             out[name] = err
+    if replayed:
+        out["replayed_legs"] = replayed
     print(json.dumps(out))
 
 

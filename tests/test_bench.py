@@ -13,6 +13,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -82,6 +83,62 @@ def test_partial_evidence_drop(tmp_path):
     with open(partial / "transformer.json") as f:
         dropped = json.load(f)
     assert dropped["global_steps"] == 4
+
+
+def test_replayed_leg_fallback(tmp_path, monkeypatch):
+    """A device leg that produced nothing this run falls back to the
+    watcher's persisted per-leg evidence (bench.load_partial_leg), and a
+    bench whose numbers came from replay is NOT counted as a fresh
+    capture by bench_watch.bench_done."""
+    scripts_dir = os.path.join(ROOT, "scripts")
+    sys.path.insert(0, scripts_dir)
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+        import bench_watch
+    finally:
+        sys.path.remove(ROOT)
+        sys.path.remove(scripts_dir)
+
+    partial = tmp_path / "legs"
+    partial.mkdir()
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(partial / "mnist.json", "w") as f:
+        json.dump({"avg_exp_per_second": 24262.0, "mfu": 0.001,
+                   "captured_utc": now}, f)
+    monkeypatch.setenv("TFOS_BENCH_PARTIAL_DIR", str(partial))
+
+    stats, captured = bench.load_partial_leg("mnist")
+    assert stats["avg_exp_per_second"] == 24262.0
+    assert captured == now
+    assert bench.load_partial_leg("resnet") == (None, None)
+
+    # evidence past the age limit is refused — a new round's tunnel-down
+    # bench must not resurrect a previous round's numbers — and so is
+    # UNSTAMPED evidence: file mtime is reset by git checkout, so it
+    # cannot stand in for a capture time
+    with open(partial / "resnet.json", "w") as f:
+        json.dump({"mfu": 0.5, "captured_utc": "2020-01-01T00:00:00Z"}, f)
+    assert bench.load_partial_leg("resnet") == (None, None)
+    with open(partial / "resnet.json", "w") as f:
+        json.dump({"mfu": 0.5}, f)  # no captured_utc
+    assert bench.load_partial_leg("resnet") == (None, None)
+
+    # the watcher must keep hunting for a real window when the bench's
+    # device numbers were replayed rather than measured
+    fresh = {"mnist_e2e_images_per_sec_per_chip": 1.0, "value": 0.1,
+             "transformer_lm_step_time_ms": 5.0}
+    out_dir = bench_watch.OUT_DIR
+    try:
+        bench_watch.OUT_DIR = str(tmp_path)
+        with open(tmp_path / "bench.json", "w") as f:
+            json.dump(dict(fresh, replayed_legs={"mnist": captured}), f)
+        assert not bench_watch.bench_done()
+        with open(tmp_path / "bench.json", "w") as f:
+            json.dump(fresh, f)
+        assert bench_watch.bench_done()
+    finally:
+        bench_watch.OUT_DIR = out_dir
 
 
 def test_lm_tune_ladder_smoke(tmp_path):

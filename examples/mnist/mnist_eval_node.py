@@ -72,6 +72,10 @@ def evaluator_fun(args, ctx):
     _, trainer = _build(args)
     mgr = checkpoint.CheckpointManager(model_dir, save_interval_steps=0)
     seen = -1
+    # idle timeout, not a lifetime cap: every evaluated checkpoint pushes
+    # the deadline out — a loaded host where training itself takes longer
+    # than eval_timeout must not silently lose the final eval; the loop
+    # only gives up after eval_timeout with NO new checkpoint appearing
     deadline = time.time() + args.eval_timeout
     while time.time() < deadline:
         # cheap step probe first: a full restore on every 1 s idle poll
@@ -82,6 +86,7 @@ def evaluator_fun(args, ctx):
         state, step = mgr.restore_latest(jax.device_get(trainer.state))
         if step is not None and step > seen:
             seen = step
+            deadline = time.time() + args.eval_timeout
             l, aux = loss(state.params, eval_batch, mask)
             metrics = {"step": int(step), "loss": float(l),
                        "accuracy": float(aux["accuracy"])}
@@ -145,11 +150,65 @@ def main(argv=None):
 
     b = backend.LocalBackend(args.cluster_size)
     try:
+        baseline = _metrics_line_count(args)  # stale lines from a prior run
         c = cluster.run(b, main_fun, args, num_executors=args.cluster_size,
                         eval_node=True, input_mode=cluster.InputMode.FILES)
+        _await_final_eval(args, baseline)
         c.shutdown(grace_secs=5)
     finally:
         b.stop()
+
+
+def _metrics_line_count(args):
+    try:
+        with open(os.path.join(args.model_dir, "eval_metrics.jsonl")) as f:
+            return sum(1 for _ in f)  # raw count: the waiter slices raw lines
+    except OSError:
+        return 0
+
+
+def _await_final_eval(args, baseline):
+    """Block until THIS run's evaluator has scored the FINAL checkpoint.
+
+    ``train_and_evaluate`` semantics (reference
+    ``examples/mnist/estimator/mnist_tf.py:109-115``): the run isn't done
+    until the last checkpoint has an eval.  Without this, shutdown races
+    the evaluator's restore of the final step — workers finish, the
+    driver poisons the cluster, and a slow restore loses the last eval.
+    Only lines past ``baseline`` count: eval_metrics.jsonl is append-only,
+    so a reused model_dir carries satisfied-looking steps from a previous
+    run.
+
+    The timeout is an IDLE timeout (matching the evaluator's own loop):
+    ``cluster.run`` returns at rendezvous — before training — so a fixed
+    lifetime deadline would bill training time against ``eval_timeout``
+    and give up mid-training on a loaded host.  Any observable progress
+    (a new metrics line, a new checkpoint directory) pushes it out."""
+    metrics_path = os.path.join(args.model_dir, "eval_metrics.jsonl")
+    deadline = time.time() + args.eval_timeout
+    progress = None
+    while time.time() < deadline:
+        try:
+            with open(metrics_path) as f:
+                lines = [line for line in list(f)[baseline:] if line.strip()]
+            steps = [json.loads(line)["step"] for line in lines]
+            if steps and max(steps) >= args.max_steps:
+                return
+        except (OSError, ValueError, KeyError):
+            lines = []
+        try:
+            ckpt_steps = sorted(int(d) for d in os.listdir(args.model_dir)
+                                if d.isdigit())
+        except OSError:
+            ckpt_steps = []
+        now_progress = (len(lines), ckpt_steps[-1] if ckpt_steps else -1)
+        if now_progress != progress:
+            progress = now_progress
+            deadline = time.time() + args.eval_timeout
+        time.sleep(0.5)
+    print("warning: evaluator never scored step {} within {}s of last "
+          "progress".format(args.max_steps, args.eval_timeout),
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -386,10 +386,17 @@ class DataFeed(object):
         upcoming feed partitions are skipped, then drains the input queue
         (reference ``TFNode.py:172-194``)."""
         logger.info("terminate() invoked: draining remaining input")
-        self.mgr.set("state", "terminating")
-        self._ack_chunk()  # release a partially-consumed chunk's join hold
-        self._buffer, self._buffer_idx = [], 0
-        queue = self.mgr.get_queue(self.qname_in)
+        try:
+            self.mgr.set("state", "terminating")
+            self._ack_chunk()  # release a partially-consumed chunk's join hold
+            self._buffer, self._buffer_idx = [], 0
+            queue = self.mgr.get_queue(self.qname_in)
+        except (EOFError, BrokenPipeError, ConnectionError, OSError):
+            # the manager died before the drain even started (driver-side
+            # shutdown won the race) — nothing left to mark or drain
+            logger.info("manager gone at terminate(); assuming shutdown")
+            self._buffer, self._buffer_idx = [], 0
+            return
         count = 0
         done = False
         while not done:
@@ -409,4 +416,13 @@ class DataFeed(object):
                     count += 1
             except _queue.Empty:
                 logger.info("dropped %d items after terminate", count)
+                done = True
+            except (EOFError, BrokenPipeError, ConnectionError, OSError):
+                # The manager died under the drain — the driver shut the
+                # cluster down while we were still discarding leftover
+                # input.  A dead manager means there is nothing left to
+                # drain (or ack to); finishing quietly is the correct
+                # outcome, not an error in the user's fn.
+                logger.info("manager gone during terminate drain "
+                            "(%d items dropped); assuming shutdown", count)
                 done = True

@@ -386,7 +386,16 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             try:
                 wrapper_fn(args, context)
             except Exception:
-                errq.put(traceback.format_exc())
+                try:
+                    errq.put(traceback.format_exc())
+                except (EOFError, BrokenPipeError, ConnectionError, OSError):
+                    # the manager (and with it the error queue) is already
+                    # gone — cluster shutdown beat us; the traceback still
+                    # goes to the executor log via the raise below, but a
+                    # dead reporting channel must not mask it with its own
+                    # BrokenPipeError
+                    logger.warning("error queue unreachable during "
+                                   "shutdown; traceback follows in log")
                 raise
 
         if job_name in ("ps", "evaluator") or background:

@@ -61,6 +61,12 @@ VARIANTS = {
     # chip): what the grouped expert einsums cost vs the dense MLP --
     # the on-chip half of the EP story the CPU-mesh suite can't price
     "moe": {"mlp": "moe"},
+    # every arithmetic-intensity lever at once (d2048 x 16L x b16):
+    # ~850M params, the largest config that plausibly fits one v5e chip
+    # with adam state in bf16/f32 -- if 50% MFU is reachable through the
+    # Trainer path, this is the rung that shows it (subprocess isolation
+    # means an HBM OOM just fails this rung, not the ladder)
+    "big": {"heads": 32, "layers": 16, "batch_size": 16},
 }
 
 

@@ -94,6 +94,43 @@ class TestDataFeed:
         q = mgr.get_queue("input")
         assert q.qsize() == 0  # drained through the end-of-feed marker
 
+    def test_terminate_survives_dead_manager(self, mgr):
+        # Cluster shutdown can kill the manager while (or just before) a
+        # node drains in terminate(); a dead manager means there is
+        # nothing left to drain — terminate must finish quietly, not
+        # surface EOFError/BrokenPipeError as a user-code failure.  The
+        # feed must hold a CONNECTED proxy (the executor's view) whose
+        # server dies under it — that's the production shape of the race.
+        # (The fixture's teardown shutdown is a no-op second Finalize.)
+        client = manager.connect(mgr.address, b"test-authkey")
+        _feed(mgr, list(range(10)))
+        feed = DataFeed(client)
+        feed.next_batch(2)
+        mgr.shutdown()
+        feed.terminate()  # must not raise
+
+    def test_terminate_survives_manager_dying_mid_drain(self, mgr):
+        # Same race one window later: the pre-loop calls succeed, then the
+        # manager dies under the drain loop's queue.get.
+        _feed(mgr, list(range(5)), end_of_feed=False)
+        feed = DataFeed(mgr)
+        feed.next_batch(2)
+
+        class _DyingQueue:
+            def __init__(self, inner, mgr_to_kill):
+                self._inner, self._mgr = inner, mgr_to_kill
+
+            def get(self, *a, **k):
+                self._mgr.shutdown()
+                raise EOFError  # what the dead proxy raises
+
+            def task_done(self):
+                pass
+
+        real_get_queue = mgr.get_queue
+        mgr.get_queue = lambda name: _DyingQueue(real_get_queue(name), mgr)
+        feed.terminate()  # must not raise
+
 
 class TestManager:
     def test_kv_state(self, mgr):

@@ -266,7 +266,7 @@ def resnet_main(args, ctx):
 
 def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
                      vocab=None, attention=None, mlp=None, num_experts=None,
-                     log_steps=20):
+                     remat=False, log_steps=20):
     """(trainer, batch, mask) for the transformer-LM leg on the current
     backend's mesh — the ONE place the flagship LM benchmark model is
     defined.  ``scripts/k_ladder.py`` measures the same construction, so
@@ -294,7 +294,7 @@ def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
     model = transformer.build_transformer(
         vocab_size=vocab, num_layers=layers, num_heads=heads,
         head_dim=head_dim, max_seq_len=seq, attention=attention,
-        mlp=mlp, num_experts=num_experts, dtype="bfloat16")
+        mlp=mlp, num_experts=num_experts, remat=remat, dtype="bfloat16")
     tokens = np.arange(batch_size * seq,
                        dtype=np.int32).reshape(batch_size, seq)
     tokens %= vocab
@@ -312,10 +312,27 @@ def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
     if attention == "flash":
         extra_flops = (7 * seq * seq * head_dim * batch_size * heads
                        * layers // max(len(jax.devices()), 1))
+    # Under remat, XLA cost analysis prices the recomputed forward too, so
+    # the MFU numerator must instead be the analytic MODEL FLOPs (work
+    # that advances training, not the recompute schedule).  Matmul train
+    # FLOPs = 3x forward (backward is 2x): per token forward, qkv 6d^2 +
+    # out-proj 2d^2 + mlp 16d^2 = 24d^2 per layer, plus the 2dV readout;
+    # attention QK^T+PV forward = 4 S^2 Dh per (batch, head, layer) for
+    # full attention (the masked half IS executed) and half that causal
+    # (flash).  Per-device via the batch-sharding convention.
+    override = None
+    if remat:
+        d_model = heads * head_dim
+        fwd = batch_size * seq * (24 * d_model * d_model * layers
+                                  + 2 * d_model * vocab)
+        attn_fwd_coef = 2 if attention == "flash" else 4
+        fwd += attn_fwd_coef * seq * seq * head_dim * batch_size * heads * layers
+        override = 3 * fwd // max(len(jax.devices()), 1)
     trainer = train_mod.Trainer(
         transformer.loss_fn(model), params, optax.adam(1e-3), mesh=mesh,
         compute_dtype=jnp.bfloat16, batch_size=batch_size,
-        log_steps=log_steps, extra_step_flops=extra_flops)
+        log_steps=log_steps, extra_step_flops=extra_flops,
+        step_flops_override=override)
     sharding = mesh_mod.batch_sharding(mesh, extra_dims=1)
     batch = {"tokens": jax.device_put(jnp.asarray(tokens), sharding)}
     mask = jax.device_put(np.ones((batch_size,), np.float32),
@@ -325,6 +342,11 @@ def build_lm_trainer(batch_size=None, seq=None, layers=None, heads=None,
               "mlp": mlp}
     if mlp == "moe":
         config["num_experts"] = num_experts
+    if remat:
+        # self-describing: this config's MFU numerator is the analytic
+        # model-FLOPs figure, not XLA cost analysis of the remat program
+        config["remat"] = True
+        config["mfu_numerator"] = "analytic_model_flops"
     return trainer, batch, mask, config
 
 

@@ -62,11 +62,15 @@ VARIANTS = {
     # the on-chip half of the EP story the CPU-mesh suite can't price
     "moe": {"mlp": "moe"},
     # every arithmetic-intensity lever at once (d2048 x 16L x b16):
-    # ~850M params, the largest config that plausibly fits one v5e chip
-    # with adam state in bf16/f32 -- if 50% MFU is reachable through the
-    # Trainer path, this is the rung that shows it (subprocess isolation
-    # means an HBM OOM just fails this rung, not the ladder)
-    "big": {"heads": 32, "layers": 16, "batch_size": 16},
+    # ~870M params, the largest config that plausibly fits one v5e chip
+    # with adam state -- if 50% MFU is reachable through the Trainer
+    # path, this is the rung that shows it.  remat is required: without
+    # it the backward pass stores each layer's S x S attention probs
+    # (b16 x H32 x 1024^2 bf16 = ~1 GB/layer x 16L) and activations well
+    # past 16 GB HBM; recompute trades ~1/3 more FLOPs for fitting
+    # (subprocess isolation means an HBM OOM just fails this rung, not
+    # the ladder)
+    "big": {"heads": 32, "layers": 16, "batch_size": 16, "remat": True},
 }
 
 

@@ -239,6 +239,7 @@ class TransformerLM(nn.Module):
     ep_mode: str = "gspmd"    # gspmd | shard_map (see MoEMlp)
     mesh: Optional[object] = None
     ep_batch_axes: Optional[tuple] = None
+    remat: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -249,14 +250,20 @@ class TransformerLM(nn.Module):
         pos = nn.Embed(self.max_seq_len, d_model, dtype=self.dtype,
                        name="pos_embed")(jnp.arange(tokens.shape[1]))
         x = x + pos[None]
+        # remat trades FLOPs for HBM: each block's activations (incl. the
+        # full-attention S x S probs the backward pass would otherwise
+        # keep per layer) are recomputed during backprop instead of
+        # stored — the standard TPU recipe for configs whose stored
+        # activations exceed HBM (e.g. d2048 x 16L x b16 full attention).
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.num_layers):
-            x = Block(self.num_heads, self.head_dim,
-                      attention=self.attention, mlp=self.mlp,
-                      num_experts=self.num_experts,
-                      capacity_factor=self.capacity_factor,
-                      ep_mode=self.ep_mode, mesh=self.mesh,
-                      ep_batch_axes=self.ep_batch_axes,
-                      dtype=self.dtype, name="block_%d" % i)(x)
+            x = block_cls(self.num_heads, self.head_dim,
+                          attention=self.attention, mlp=self.mlp,
+                          num_experts=self.num_experts,
+                          capacity_factor=self.capacity_factor,
+                          ep_mode=self.ep_mode, mesh=self.mesh,
+                          ep_batch_axes=self.ep_batch_axes,
+                          dtype=self.dtype, name="block_%d" % i)(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
         # weight-tied readout keeps the big vocab matmul on the MXU once
         embed = self.variables["params"]["embed"]["embedding"]
@@ -268,14 +275,14 @@ def build_transformer(vocab_size=32000, num_layers=4, num_heads=8,
                       head_dim=64, max_seq_len=2048, attention="full",
                       mlp="dense", num_experts=8, capacity_factor=1.25,
                       ep_mode="gspmd", mesh=None, ep_batch_axes=None,
-                      dtype="float32"):
+                      remat=False, dtype="float32"):
     return TransformerLM(vocab_size=vocab_size, num_layers=num_layers,
                          num_heads=num_heads, head_dim=head_dim,
                          max_seq_len=max_seq_len, attention=attention,
                          mlp=mlp, num_experts=num_experts,
                          capacity_factor=capacity_factor, ep_mode=ep_mode,
                          mesh=mesh, ep_batch_axes=ep_batch_axes,
-                         dtype=jnp.dtype(dtype))
+                         remat=remat, dtype=jnp.dtype(dtype))
 
 
 def _sum_moe_aux(tree):

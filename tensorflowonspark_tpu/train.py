@@ -98,7 +98,7 @@ class Trainer(object):
                  extra_state=None, compute_dtype=None, batch_size=None,
                  log_steps=20, donate=True, accum_steps=1,
                  summary_writer=None, param_sharding=None,
-                 extra_step_flops=0):
+                 extra_step_flops=0, step_flops_override=None):
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh()
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -118,6 +118,14 @@ class Trainer(object):
         # legs) and passes it here; added to the cost-analysis estimate
         # when TimeHistory is built.
         self.extra_step_flops = extra_step_flops
+        # Full replacement of the MFU numerator: MODEL FLOPs stated by the
+        # model owner.  XLA cost analysis prices the EXECUTED program —
+        # under rematerialization that includes the recomputed forward, so
+        # a remat model's cost-analysis MFU is inflated by work that isn't
+        # model progress.  When set, cost analysis is skipped entirely
+        # (extra_step_flops is ignored too: the override is the whole
+        # numerator).
+        self.step_flops_override = step_flops_override
         self._has_extra = extra_state is not None
 
         self.state = TrainState(
@@ -307,16 +315,19 @@ class Trainer(object):
 
                 example_batch = jax.tree_util.tree_map(strip, example_batch)
                 example_mask = jax.tree_util.tree_map(strip, example_mask)
-            flops = metrics_mod.estimate_step_flops(
-                jax.jit(self._plain_core), self.state,
-                example_batch, example_mask)
-            # only supplement a SUCCESSFUL base estimate: when cost
-            # analysis is unavailable (returns None) the supplement alone
-            # would publish a confidently tiny MFU with the matmul work
-            # missing from the numerator — None (honestly unknown) is the
-            # right answer there
-            if self.extra_step_flops and flops:
-                flops = flops + self.extra_step_flops
+            if self.step_flops_override is not None:
+                flops = self.step_flops_override
+            else:
+                flops = metrics_mod.estimate_step_flops(
+                    jax.jit(self._plain_core), self.state,
+                    example_batch, example_mask)
+                # only supplement a SUCCESSFUL base estimate: when cost
+                # analysis is unavailable (returns None) the supplement
+                # alone would publish a confidently tiny MFU with the
+                # matmul work missing from the numerator — None (honestly
+                # unknown) is the right answer there
+                if self.extra_step_flops and flops:
+                    flops = flops + self.extra_step_flops
             self.history = metrics_mod.TimeHistory(
                 batch_size=self.batch_size or 0, log_steps=self.log_steps,
                 step_flops=flops, summary_writer=self.summary_writer)

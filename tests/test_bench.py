@@ -141,6 +141,33 @@ def test_replayed_leg_fallback(tmp_path, monkeypatch):
         bench_watch.OUT_DIR = out_dir
 
 
+def test_remat_mfu_uses_analytic_model_flops():
+    """A remat LM trainer's MFU numerator must be the analytic MODEL
+    FLOPs, not XLA cost analysis of the executed program (which would
+    count the rematerialized forward as if it were model progress)."""
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(ROOT)
+    import jax
+
+    # batch divisible by the conftest's 8-virtual-device data axis
+    b, s, layers, heads, vocab = 16, 32, 2, 2, 128
+    trainer, batch, mask, cfg = bench.build_lm_trainer(
+        batch_size=b, seq=s, layers=layers, heads=heads, vocab=vocab,
+        remat=True, log_steps=10 ** 9)
+    assert cfg["remat"] is True
+    assert cfg["mfu_numerator"] == "analytic_model_flops"
+    d = heads * 64
+    fwd = b * s * (24 * d * d * layers + 2 * d * vocab)
+    fwd += 4 * s * s * 64 * b * heads * layers
+    want = 3 * fwd // max(len(jax.devices()), 1)
+    assert trainer.step_flops_override == want
+    trainer.step(batch)  # history builds on first step
+    assert trainer.history.step_flops == want
+
+
 def test_lm_tune_ladder_smoke(tmp_path):
     """The lm_tune ladder (scripts/lm_tune.py) runs a variant end-to-end
     on CPU and persists the aggregate JSON after each variant — the

@@ -146,6 +146,33 @@ class TestTransformer:
         loss2, _ = tr.step(batch)
         assert np.isfinite(float(loss1)) and float(loss2) < float(loss1)
 
+    def test_remat_is_equivalent(self):
+        """remat=True recomputes block activations in backward — outputs
+        AND gradients must match the stored-activation model exactly
+        (same math, different schedule)."""
+        kwargs = dict(vocab_size=64, num_layers=2, num_heads=4, head_dim=8,
+                      max_seq_len=32)
+        base = models.get_model("transformer_lm", **kwargs)
+        rem = models.get_model("transformer_lm", remat=True, **kwargs)
+        tokens = jnp.asarray(
+            np.random.RandomState(1).randint(0, 64, (2, 32)))
+        params = base.init(jax.random.PRNGKey(0), tokens)["params"]
+        np.testing.assert_allclose(
+            np.asarray(rem.apply({"params": params}, tokens)),
+            np.asarray(base.apply({"params": params}, tokens)),
+            atol=1e-5, rtol=1e-5)
+        mask = jnp.ones((2,), jnp.float32)
+        g_base = jax.grad(
+            lambda p: transformer.loss_fn(base)(p, {"tokens": tokens},
+                                                mask)[0])(params)
+        g_rem = jax.grad(
+            lambda p: transformer.loss_fn(rem)(p, {"tokens": tokens},
+                                               mask)[0])(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4),
+            g_base, g_rem)
+
     def test_lm_loss_decreases(self):
         mesh = build_mesh()
         model = models.get_model("transformer_lm", vocab_size=32,

@@ -338,13 +338,23 @@ def main():
     attempts = {name: 0 for name, _, _ in steps}
     MAX_ATTEMPTS = 3
 
+    down_streak = 0
     while time.time() < deadline:
-        kind, err = probe()
+        # Hedge against a SLOW tunnel (vs a dead one): a reconnecting
+        # endpoint could legitimately take >60 s to answer — bench.py's
+        # own probe allows 150 s — so a long down-streak mixes in a
+        # patient probe every 4th cycle.  Cost while dead: the cycle
+        # stretches ~105 s -> ~195 s once per ~7 min; a genuinely slow-up
+        # window stops being invisible to the watcher.
+        timeout = 150 if (down_streak and down_streak % 4 == 0) else 60
+        kind, err = probe(timeout=timeout)
         if not kind:
+            down_streak += 1
             log("tunnel down (%s); next probe in %ds"
                 % (err, int(args.interval)))
             time.sleep(args.interval)
             continue
+        down_streak = 0
         log("DEVICE UP: %s -- resuming playbook" % kind)
         for name, done, fn in steps:
             if done():

@@ -70,13 +70,17 @@ class JobHandle(object):
         self._completed = 0
         self.error = None  # first task error (formatted traceback string)
         self.results = [None] * num_tasks
+        self.task_errors = [None] * num_tasks  # per-task error strings
 
     def _task_done(self, index, ok, payload):
         with self._lock:
             if ok:
                 self.results[index] = payload
-            elif self.error is None:
-                self.error = payload
+                self.task_errors[index] = None
+            else:
+                self.task_errors[index] = payload
+                if self.error is None:
+                    self.error = payload
             self._completed += 1
             if self._completed >= self.num_tasks or not ok:
                 self._done.set()
@@ -107,6 +111,30 @@ class JobHandle(object):
             raise RuntimeError("job failed:\n{}".format(self.error))
         return self.results
 
+    def wait_settled(self, timeout=None):
+        """Block until EVERY task reached a terminal state (ok, failed, or
+        skipped) — unlike :meth:`wait`, which fires on the *first* failure
+        while sibling tasks may still be in flight.  The retry machinery
+        needs the settled view: retrying a partition whose original task is
+        still running would double-feed its rows.
+        """
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            with self._lock:
+                if self._completed >= self.num_tasks:
+                    return
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(
+                    "job did not settle within {}s".format(timeout))
+            time.sleep(0.05)
+
+    def failed_tasks(self):
+        """``[(task_index, error_string), ...]`` for tasks that failed or
+        were skipped; call after :meth:`wait_settled`."""
+        with self._lock:
+            return [(i, e) for i, e in enumerate(self.task_errors)
+                    if e is not None]
+
 
 # ---------------------------------------------------------------------------
 # LocalBackend: executor worker process main loop
@@ -125,7 +153,14 @@ def _executor_main(executor_index, workdir, conn, env_overrides):
     os.chdir(workdir)
     import threading as _threading
 
+    from tensorflowonspark_tpu import fault
+
     _threading.current_thread().name = "executor-{}".format(executor_index)
+    # Resolved once per executor (counters are per-process).  Note: specs
+    # targeted with ``executor_id`` resolve to NULL here — the executor-id
+    # file doesn't exist until a node's start task writes it — so target
+    # executor-loss faults via ``env_per_executor`` instead.
+    injector = fault.from_env()
     while True:
         try:
             msg = conn.recv()
@@ -142,6 +177,7 @@ def _executor_main(executor_index, workdir, conn, env_overrides):
             conn.send((task_id, True, result))
         except Exception:
             conn.send((task_id, False, traceback.format_exc()))
+        injector.on_task()  # kill_after_tasks: die AFTER serving N tasks
 
 
 class LocalBackend(object):
@@ -158,6 +194,10 @@ class LocalBackend(object):
         own cwd, which is what makes the executor-id file handshake work.
     """
 
+    #: Per-task outcomes (JobHandle.task_errors) are real here, so the
+    #: driver's supervised feed retry can re-dispatch failed partitions.
+    supports_task_retry = True
+
     def __init__(self, num_executors, env=None, env_per_executor=None, workdir_root=None):
         self.num_executors = num_executors
         self._owns_root = workdir_root is None
@@ -167,6 +207,7 @@ class LocalBackend(object):
         self._conns = []
         self._free = _queue.Queue()
         self._stopped = False
+        self._excluded = set()  # executor indices fenced off from scheduling
         for i in range(num_executors):
             overrides = dict(env or {})
             if env_per_executor:
@@ -219,8 +260,22 @@ class LocalBackend(object):
                 ),
             )
         finally:
-            if self._procs[executor_index].is_alive():
+            if (self._procs[executor_index].is_alive()
+                    and executor_index not in self._excluded):
                 self._free.put(executor_index)
+
+    def exclude(self, executor_index):
+        """Fence an executor off from future scheduling (liveness monitor:
+        its node process died, so tasks landing there would feed a corpse).
+        In-flight tasks finish/fail on their own; the slot is simply never
+        returned to the free pool."""
+        if 0 <= executor_index < self.num_executors:
+            self._excluded.add(executor_index)
+            logger.warning("executor %d excluded from scheduling", executor_index)
+
+    def _live_executors(self):
+        return [i for i, p in enumerate(self._procs)
+                if p.is_alive() and i not in self._excluded]
 
     def foreach_partition_async(self, partitions, fn):
         """Dispatch ``fn(iter(partition))`` per partition onto free executors."""
@@ -240,7 +295,29 @@ class LocalBackend(object):
                         "task skipped: job cancelled after an earlier task "
                         "failure")
                     continue
-                executor_index = self._free.get()  # blocks until a slot frees up
+                # Poll the free queue instead of blocking forever: a dead or
+                # excluded executor's slot never returns, so a bare get()
+                # would starve the dispatcher once nodes start dying.
+                executor_index = None
+                while executor_index is None:
+                    try:
+                        executor_index = self._free.get(timeout=1.0)
+                    except _queue.Empty:
+                        if self._stopped:
+                            break
+                        if not self._live_executors():
+                            break  # no executor can ever serve this task
+                        continue
+                    if (executor_index in self._excluded
+                            or not self._procs[executor_index].is_alive()):
+                        executor_index = None  # drop the stale slot token
+                if executor_index is None:
+                    handle._task_done(
+                        task_id, False,
+                        "backend stopped" if self._stopped else
+                        "task {} unschedulable: no live executors remain "
+                        "(all died or were excluded)".format(task_id))
+                    continue
                 if self._stopped:
                     handle._task_done(task_id, False, "backend stopped")
                     continue
@@ -294,6 +371,10 @@ class SparkBackend(object):
 
     ``partitions`` arguments may be RDDs (used as-is) or lists (parallelized).
     """
+
+    #: Spark only reports job-level outcomes to the driver (task retries are
+    #: Spark's own); the supervised feed retry therefore skips this backend.
+    supports_task_retry = False
 
     def __init__(self, sc, num_executors=None):
         import pyspark  # gated: only needed when this backend is chosen

@@ -135,6 +135,23 @@ class CheckpointManager(object):
         self._mgr.close()
 
 
+def abstract_state(state):
+    """Abstract (shape/dtype/sharding) view of a live state pytree — the
+    template :meth:`CheckpointManager.restore_latest` restores into, so the
+    restored arrays land with the SAME sharding the running state uses
+    (restore-then-reshard would double peak memory)."""
+    import jax
+    import numpy as np
+
+    def one(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        arr = np.asarray(x)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    return jax.tree_util.tree_map(one, state)
+
+
 def _globalize(tree):
     """Make every leaf serializable in multi-host worlds.
 

@@ -54,7 +54,7 @@ class TPUCluster(object):
     # -- data plane -------------------------------------------------------
 
     def train(self, data, num_epochs=1, feed_timeout=600, qname="input",
-              chunk_size=1024):
+              chunk_size=1024, retry_policy=None):
         """Feed partitioned data for training (InputMode.SPARK only;
         reference ``TFCluster.py:61-92``).
 
@@ -73,6 +73,16 @@ class TPUCluster(object):
 
         ``chunk_size`` governs feed amortization: rows travel in columnar
         chunks of this many rows (see ``node.train``).
+
+        ``retry_policy``: optional
+        :class:`~tensorflowonspark_tpu.fault.RetryPolicy` supervising the
+        feed job (list-of-partitions data only): after ALL tasks settle,
+        partitions whose tasks failed retryably (dead node/executor, drain
+        timeout, cancelled sibling — see ``fault.RETRYABLE_PATTERNS``) are
+        re-dispatched with backoff onto the live executors; the surviving
+        nodes re-consume them from their own queues.  User-code failures
+        stay fatal.  RDD/DStream/iterator data ignores the policy (Spark
+        applies its own task-level retries there).
         """
         logger.info("Feeding training data")
         assert self.input_mode == InputMode.SPARK, \
@@ -115,21 +125,69 @@ class TPUCluster(object):
                 self._latch_error(e)
                 raise
         elif hasattr(data, "foreachPartition"):  # Spark RDD
+            if retry_policy is not None:
+                logger.info("retry_policy ignored for RDD data: Spark "
+                            "retries failed tasks itself")
             self._feed_or_latch(data, fn)
         else:
-            self._feed_or_latch(list(data), fn)
+            self._feed_or_latch(list(data), fn, retry_policy)
 
-    def _feed_or_latch(self, partitions, fn):
+    def _feed_or_latch(self, partitions, fn, retry_policy=None):
         """Dispatch a feed job; a failure (user-code error OR a consumer
         that died without one — e.g. OOM-killed, surfaced as the feeder's
         feed_timeout) is latched into ``tf_status`` so a later
         ``shutdown()`` still exits non-zero (reference ``tf_status``
         error propagation, ``TFCluster.py:177-181``)."""
         try:
-            self.backend.foreach_partition(partitions, fn)
+            if retry_policy is not None:
+                self._dispatch_with_retry(partitions, fn, retry_policy)
+            else:
+                self.backend.foreach_partition(partitions, fn)
         except Exception as e:
             self._latch_error(e)
             raise
+
+    def _dispatch_with_retry(self, partitions, fn, policy):
+        """Supervised feed dispatch: wait for the job to SETTLE (every task
+        terminal — retrying while a sibling is still feeding would
+        double-ship its partition), then re-dispatch only the failed
+        partitions, with the policy's backoff, while every failure stays
+        retryable and attempts remain."""
+        if not getattr(self.backend, "supports_task_retry", False):
+            # Job-level backends (Spark) can't observe per-partition task
+            # outcomes, and re-running the whole job would double-feed the
+            # partitions that succeeded; Spark's own task retries cover
+            # these deployments.
+            logger.info("backend %s has no per-task outcome visibility; "
+                        "dispatching unsupervised",
+                        type(self.backend).__name__)
+            self.backend.foreach_partition(partitions, fn)
+            return
+        parts = list(partitions)
+        pending = list(range(len(parts)))  # indices into parts
+        for attempt in range(policy.max_attempts):
+            handle = self.backend.foreach_partition_async(
+                [parts[i] for i in pending], fn)
+            handle.wait_settled()
+            failed = handle.failed_tasks()
+            if not failed:
+                return
+            errors = [e for _, e in failed]
+            fatal = [e for e in errors if not policy.is_retryable(e)]
+            if fatal or attempt + 1 >= policy.max_attempts:
+                raise RuntimeError("feed job failed{}:\n{}".format(
+                    "" if fatal else
+                    " after {} attempts".format(policy.max_attempts),
+                    (fatal or errors)[0]))
+            delay = policy.backoff(attempt)
+            logger.warning(
+                "feed job: %d of %d partition task(s) failed retryably; "
+                "retrying in %.1fs (attempt %d/%d). First error:\n%s",
+                len(failed), len(pending), delay, attempt + 2,
+                policy.max_attempts, errors[0])
+            time.sleep(delay)
+            pending = [pending[i] for i, _ in failed]
+        raise AssertionError("unreachable")  # pragma: no cover
 
     def _latch_error(self, exc):
         if "error" not in self.tf_status:
@@ -361,7 +419,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         master_node=None, reservation_timeout=600,
         queues=("input", "output", "error"), eval_node=False,
         release_port=True, profiler=False, executor_env=None,
-        driver_ps_nodes=False):
+        driver_ps_nodes=False, heartbeat_interval=5.0, heartbeat_misses=3):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -387,6 +445,13 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         initialization — TPU/XLA perf knobs travel here (build with
         :func:`~tensorflowonspark_tpu.device_info.tpu_env`; the analog of the
         reference's GPU-thread tuning, reference ``common.py:143-166``).
+      heartbeat_interval: seconds between node liveness beats to the
+        reservation server (0 disables monitoring).  A node silent for
+        ``heartbeat_interval * heartbeat_misses`` seconds is declared dead:
+        its identity lands in ``tf_status['dead_nodes']``, a blocked
+        ``await_reservations`` aborts immediately, and the executor is
+        fenced off from further feed-task scheduling (built-in backend).
+      heartbeat_misses: missed beats tolerated before declaring death.
     """
     if hasattr(cluster_backend, "parallelize"):  # raw SparkContext
         cluster_backend = backend_mod.SparkBackend(cluster_backend)
@@ -413,8 +478,26 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
             cluster_template["worker"] = executors[1:]
     logger.info("cluster template: %s", cluster_template)
 
-    # Rendezvous server (reference TFCluster.py:277-279).
-    server = reservation.Server(num_executors)
+    # Shared driver-side status dict: async start-job failures land in
+    # 'error' (fatal); the liveness monitor appends to 'dead_nodes'
+    # (recoverable — a supervised retry may complete the run regardless).
+    tf_status = {}
+
+    def _on_dead(meta, age):
+        desc = ("node {}:{} (executor {}) on {} declared dead after {:.1f}s "
+                "of heartbeat silence").format(
+                    meta.get("job_name", "?"), meta.get("task_index", "?"),
+                    meta.get("executor_id", "?"), meta.get("host", "?"), age)
+        tf_status.setdefault("dead_nodes", []).append(desc)
+        if (hasattr(cluster_backend, "exclude")
+                and meta.get("executor_id") is not None):
+            cluster_backend.exclude(meta["executor_id"])
+
+    # Rendezvous server (reference TFCluster.py:277-279) + liveness monitor.
+    server = reservation.Server(num_executors,
+                                heartbeat_interval=heartbeat_interval,
+                                heartbeat_misses=heartbeat_misses,
+                                on_dead=_on_dead)
     server_addr = server.start()
 
     cluster_meta = {
@@ -427,6 +510,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         "reservation_timeout": reservation_timeout,
         "input_mode": input_mode,
         "executor_env": dict(executor_env or {}),
+        "heartbeat_interval": heartbeat_interval,
     }
 
     # Launch the start job in the background (reference daemon thread +
@@ -465,8 +549,6 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
 
     # Propagate async start-job failures into the reservation wait (reference
     # tf_status error flag, TFCluster.py:38,321-323 + reservation.py:117-120).
-    tf_status = {}
-
     def _monitor():
         while not start_job.done():
             if start_job.error:

@@ -16,7 +16,7 @@ import threading
 
 import numpy as np
 
-from tensorflowonspark_tpu import marker
+from tensorflowonspark_tpu import fault, marker
 
 logger = logging.getLogger(__name__)
 
@@ -117,6 +117,10 @@ class DataFeed(object):
         # another thread can take over queue consumption (the queue/ring is
         # single-consumer; see ShardedFeed.terminate).
         self._interrupt = threading.Event()
+        # Chaos hook: consumption-side fault injection ("node dies / fails
+        # after N items") — a null object unless TFOS_FAULT_SPEC targets
+        # this process (see tensorflowonspark_tpu.fault).
+        self._fault = fault.from_env()
 
     def next_batch(self, batch_size):
         """Get up to ``batch_size`` items from the input queue.
@@ -187,6 +191,7 @@ class DataFeed(object):
                     # a crash on a malformed item above must leave the queue
                     # un-joined so the feeder's error-poll fires (see ctor).
                     self._ack_chunk()
+        self._fault.on_items(count)
         logger.debug("next_batch: returning %d items", count)
         return tensors
 
@@ -336,6 +341,7 @@ class DataFeed(object):
             parts.append(fields)
             count += 1
             queue.task_done()
+        self._fault.on_items(count)
         return self._assemble_columns(parts, tuple_rows, dtypes), count
 
     def _assemble_columns(self, parts, tuple_rows, dtypes):

@@ -1,0 +1,338 @@
+"""Fault-tolerance primitives: retry policy and fault injection.
+
+The reference framework's whole recovery story was "Spark retries the job and
+TF restores from the last checkpoint" (SURVEY §5.3).  This module makes both
+halves first-class for the TPU framework:
+
+- :class:`RetryPolicy` — exponential backoff + jitter + retryable-error
+  classification, shared by the driver's supervised feed-job retry
+  (:meth:`~tensorflowonspark_tpu.cluster.TPUCluster.train`) and the trainer's
+  supervised restart (:func:`~tensorflowonspark_tpu.train.fit_supervised`,
+  which restores-latest from a
+  :class:`~tensorflowonspark_tpu.checkpoint.CheckpointManager`).
+- :class:`FaultInjector` — env/ctx-driven chaos harness that can kill a node
+  at item/step N, drop heartbeats, delay or close control-plane sockets, and
+  corrupt a queue chunk.  Wired into the hot paths
+  (:class:`~tensorflowonspark_tpu.datafeed.DataFeed` consumption, the
+  heartbeat sender, the built-in backend's executor loop, the feed chunk
+  putter) behind a null-object default, so production runs pay one env lookup
+  per process and chaos tests exercise the REAL failure paths instead of
+  ad-hoc ``raise RuntimeError("injected ...")`` in user fns.
+
+Classification contract: infrastructure failures (an executor or node process
+that died, a drain timeout, a cancelled sibling task, connection loss) are
+retryable — re-running the work elsewhere can succeed.  User-code exceptions
+(surfaced as ``"Exception in user code"`` tracebacks) are NOT: the same code
+fed the same data fails the same way, and retrying silently re-trains on
+duplicate rows.
+"""
+
+import json
+import logging
+import os
+import random
+import re
+import signal
+import time
+
+logger = logging.getLogger(__name__)
+
+#: Environment variable carrying a JSON :class:`FaultInjector` spec.  The
+#: built-in backend's per-executor env overrides are the targeting mechanism:
+#: set the spec on exactly the executor whose node should fail.
+FAULT_SPEC_ENV = "TFOS_FAULT_SPEC"
+
+
+class InjectedFailure(RuntimeError):
+    """An error raised deliberately by the fault-injection harness.
+
+    Simulates a *user-code* failure, so the default :class:`RetryPolicy`
+    classifies it non-retryable (chaos tests that want a retryable injected
+    failure pass ``extra_retryable=["injected"]``).
+    """
+
+
+def fail(message="injected failure"):
+    """Raise an :class:`InjectedFailure` unconditionally.
+
+    The one-line replacement for the ad-hoc ``raise RuntimeError("injected
+    ...")`` scattered through older tests — failures stay greppable under a
+    single type and classification rule.
+    """
+    raise InjectedFailure(message)
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+#: Error-string patterns that mark a failure as infrastructure (retryable).
+#: Matched case-insensitively against ``str(exc)`` / the formatted traceback.
+RETRYABLE_PATTERNS = (
+    r"executor \d+ died",                # LocalBackend: executor process gone
+    r"node process .* died",             # feeder's dead-consumer fast-fail
+    r"task skipped: job cancelled",      # sibling cancelled before dispatch
+    r"backend stopped",
+    r"timeout \(\d+(\.\d+)?s\) waiting for the consumer",  # feed drain timeout
+    r"job did not complete within",
+    r"marked dead by the liveness monitor",
+    r"connection(error| refused| reset)",
+    r"broken pipe",
+    r"\beoferror\b",
+)
+
+#: Exception types that are retryable regardless of message.
+RETRYABLE_TYPES = (ConnectionError, EOFError, BrokenPipeError, TimeoutError)
+
+#: Patterns that force NON-retryable even if a retryable pattern also matches
+#: (a user traceback may embed e.g. a ConnectionError string).
+FATAL_PATTERNS = (
+    r"exception in user code",
+)
+
+
+class RetryPolicy(object):
+    """Exponential backoff + jitter + retryable-error classification.
+
+    Args:
+      max_attempts: total tries including the first (≥ 1).
+      initial_backoff: seconds before the first retry.
+      max_backoff: backoff ceiling in seconds.
+      multiplier: backoff growth factor per attempt.
+      jitter: fraction of the delay randomized away (0.5 → delay sampled
+        uniformly from [0.5·d, d]); decorrelates retry storms across feeders.
+      extra_retryable: additional regex patterns treated as retryable (e.g.
+        ``["injected"]`` in chaos tests).
+      retryable_fn: full override — ``fn(error) -> bool`` where ``error`` is
+        an exception or a formatted-traceback string; when given, the
+        pattern/type classification is skipped entirely.
+      rng: random source for jitter (tests inject a seeded one).
+    """
+
+    def __init__(self, max_attempts=3, initial_backoff=1.0, max_backoff=30.0,
+                 multiplier=2.0, jitter=0.5, extra_retryable=(),
+                 retryable_fn=None, rng=None):
+        assert max_attempts >= 1, max_attempts
+        self.max_attempts = max_attempts
+        self.initial_backoff = initial_backoff
+        self.max_backoff = max_backoff
+        self.multiplier = multiplier
+        self.jitter = jitter
+        self._retryable_fn = retryable_fn
+        self._patterns = [re.compile(p, re.IGNORECASE)
+                          for p in tuple(RETRYABLE_PATTERNS) + tuple(extra_retryable)]
+        self._fatal = [re.compile(p, re.IGNORECASE) for p in FATAL_PATTERNS]
+        self._rng = rng or random.Random()
+
+    def backoff(self, attempt):
+        """Delay in seconds before retry number ``attempt`` (0-based)."""
+        delay = min(self.initial_backoff * (self.multiplier ** attempt),
+                    self.max_backoff)
+        if self.jitter:
+            low = delay * (1.0 - self.jitter)
+            delay = self._rng.uniform(low, delay)
+        return delay
+
+    def is_retryable(self, error):
+        """Classify an exception (or formatted-traceback string)."""
+        if self._retryable_fn is not None:
+            return bool(self._retryable_fn(error))
+        if isinstance(error, BaseException):
+            if isinstance(error, InjectedFailure):
+                text = str(error)  # classify by message patterns only
+            elif isinstance(error, RETRYABLE_TYPES):
+                return True
+            else:
+                text = "{}: {}".format(type(error).__name__, error)
+        else:
+            text = str(error)
+        if any(p.search(text) for p in self._fatal):
+            return False
+        return any(p.search(text) for p in self._patterns)
+
+    def call(self, fn, description="operation", on_retry=None):
+        """Run ``fn()`` under this policy; retries retryable failures with
+        backoff, re-raising the last error when attempts are exhausted.
+
+        ``on_retry``: optional ``fn(attempt, exc)`` hook run before each
+        retry's backoff sleep (e.g. restore-latest from a checkpoint).
+        """
+        for attempt in range(self.max_attempts):
+            try:
+                return fn()
+            except Exception as e:
+                if (not self.is_retryable(e)
+                        or attempt + 1 >= self.max_attempts):
+                    raise
+                delay = self.backoff(attempt)
+                logger.warning(
+                    "%s failed (%s: %s); retry %d/%d in %.1fs",
+                    description, type(e).__name__, e, attempt + 1,
+                    self.max_attempts - 1, delay)
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                time.sleep(delay)
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+class _NullInjector(object):
+    """No-op injector: the production fast path (one env lookup, no branches
+    per item beyond a single attribute call)."""
+
+    enabled = False
+
+    def on_items(self, n=1):
+        pass
+
+    def on_task(self):
+        pass
+
+    def should_drop_heartbeat(self, beats_sent):
+        return False
+
+    def delay_socket(self):
+        pass
+
+    def corrupt(self, data):
+        return data
+
+    def maybe_fail(self, where):
+        pass
+
+
+NULL = _NullInjector()
+
+
+class FaultInjector(object):
+    """Env/ctx-driven fault injection for chaos testing.
+
+    Spec keys (all optional; counters are per-process):
+
+    - ``kill_after_items``: SIGKILL this process once the data feed has
+      handed out N items (the "node dies at step N" fault — an unannounced
+      death the liveness monitor must catch).
+    - ``fail_after_items``: raise :class:`InjectedFailure` (``message``)
+      once N items were consumed (a user-code failure at step N).
+    - ``kill_after_tasks``: SIGKILL the built-in backend's executor process
+      after serving N tasks (whole-executor loss).
+    - ``drop_heartbeats_after``: heartbeat sender emits N beats, then goes
+      silent while the process lives (tests missed-beat detection without a
+      real death).
+    - ``delay_connect_secs``: sleep before control-plane socket connects
+      (slow-network rendezvous).
+    - ``corrupt_chunk_index``: corrupt the Nth feed chunk's serialized bytes
+      (consumer-side desync / unpickle failure).
+    - ``message``: message for ``fail_after_items``.
+    - ``executor_id``: restrict the spec to one executor id; when absent the
+      spec applies to whichever process carries it in its environment (the
+      built-in backend's ``env_per_executor`` is the usual targeting knob).
+
+    Construct directly for in-process tests, or plant a JSON spec in
+    ``TFOS_FAULT_SPEC`` (see :meth:`from_env`) to reach executor and node
+    child processes.
+    """
+
+    enabled = True
+
+    def __init__(self, spec):
+        self.spec = dict(spec or {})
+        self._items = 0
+        self._tasks = 0
+        self._chunks = 0
+
+    @classmethod
+    def from_env(cls, environ=None):
+        """Build from ``TFOS_FAULT_SPEC`` (JSON); :data:`NULL` when unset,
+        malformed, or targeted at a different executor."""
+        environ = environ if environ is not None else os.environ
+        raw = environ.get(FAULT_SPEC_ENV)
+        if not raw:
+            return NULL
+        try:
+            spec = json.loads(raw)
+        except ValueError:
+            logger.warning("ignoring malformed %s=%r", FAULT_SPEC_ENV, raw)
+            return NULL
+        target = spec.get("executor_id")
+        if target is not None:
+            from tensorflowonspark_tpu import util
+
+            try:
+                if util.read_executor_id() != target:
+                    return NULL
+            except Exception:
+                return NULL  # no executor identity here: not the target
+        return cls(spec)
+
+    # -- injection points -------------------------------------------------
+
+    def on_items(self, n=1):
+        """Data-feed consumption hook: count ``n`` consumed items and fire
+        ``kill_after_items`` / ``fail_after_items`` when crossed."""
+        self._items += n
+        kill_at = self.spec.get("kill_after_items")
+        if kill_at is not None and self._items >= kill_at:
+            logger.warning("FaultInjector: killing pid %d after %d items",
+                           os.getpid(), self._items)
+            self._kill_self()
+        fail_at = self.spec.get("fail_after_items")
+        if fail_at is not None and self._items >= fail_at:
+            self.spec.pop("fail_after_items")  # fire once
+            fail(self.spec.get("message", "injected failure after {} items"
+                               .format(self._items)))
+
+    def on_task(self):
+        """Built-in backend executor hook: count a served task and fire
+        ``kill_after_tasks`` when crossed."""
+        self._tasks += 1
+        kill_at = self.spec.get("kill_after_tasks")
+        if kill_at is not None and self._tasks >= kill_at:
+            logger.warning("FaultInjector: killing executor pid %d after %d "
+                           "tasks", os.getpid(), self._tasks)
+            self._kill_self()
+
+    def should_drop_heartbeat(self, beats_sent):
+        """Heartbeat-sender hook: True once ``drop_heartbeats_after`` beats
+        went out (the node then looks dead to the monitor while alive)."""
+        drop_at = self.spec.get("drop_heartbeats_after")
+        return drop_at is not None and beats_sent >= drop_at
+
+    def delay_socket(self):
+        """Control-plane socket hook: sleep ``delay_connect_secs``."""
+        delay = self.spec.get("delay_connect_secs")
+        if delay:
+            time.sleep(delay)
+
+    def corrupt(self, data):
+        """Feed-chunk hook: flip bytes of the chunk whose 0-based index
+        matches ``corrupt_chunk_index``; other chunks pass through."""
+        idx = self.spec.get("corrupt_chunk_index")
+        here = self._chunks
+        self._chunks += 1
+        if idx is None or here != idx:
+            return data
+        logger.warning("FaultInjector: corrupting feed chunk %d", here)
+        corrupted = bytearray(data)
+        for i in range(min(16, len(corrupted))):
+            corrupted[i] ^= 0xFF
+        return bytes(corrupted)
+
+    def maybe_fail(self, where):
+        """Generic named failpoint: raise when spec ``fail_at == where``."""
+        if self.spec.get("fail_at") == where:
+            fail(self.spec.get("message",
+                               "injected failure at {}".format(where)))
+
+    @staticmethod
+    def _kill_self():
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def from_env(environ=None):
+    """Module-level alias for :meth:`FaultInjector.from_env` (the hot-path
+    call sites read better as ``fault.from_env()``)."""
+    return FaultInjector.from_env(environ)

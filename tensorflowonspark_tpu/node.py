@@ -378,11 +378,22 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 sys.argv = args
             fn(args, context)
 
+        heartbeat_interval = cluster_meta.get("heartbeat_interval", 0)
+
         def wrapper_fn_background(args, context):
             """Background-process wrapper: route exceptions to the error queue
             (reference TFSparkNode.py:326-332)."""
             multiprocessing.current_process().authkey = authkey
             errq = context.mgr.get_queue("error")
+            # The heartbeat lives HERE, in the process executing the user fn:
+            # a SIGKILL of training silences the beats even though the
+            # executor shell and manager survive — that silence is what the
+            # driver's liveness monitor detects.  Clean exits (including
+            # user-code exceptions, which travel via the error queue) send
+            # BYE so they are not miscounted as deaths.
+            hb = reservation.HeartbeatSender(
+                cluster_meta["server_addr"], executor_id,
+                heartbeat_interval).start()
             try:
                 wrapper_fn(args, context)
             except Exception:
@@ -397,6 +408,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                     logger.warning("error queue unreachable during "
                                    "shutdown; traceback follows in log")
                 raise
+            finally:
+                hb.stop()
 
         if job_name in ("ps", "evaluator") or background:
             # Run the user fn in a child process; ps/evaluator then park this
@@ -406,6 +419,9 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             p = multiprocessing.get_context("fork").Process(
                 target=wrapper_fn_background, args=(tf_args, ctx), daemon=True)
             p.start()
+            # Publish the user-fn pid so feeders can fast-fail on a consumer
+            # that died instead of burning the whole feed_timeout.
+            mgr.set("node_pid", p.pid)
             if job_name in ("ps", "evaluator"):
                 ctrl = mgr.get_queue("control")
                 errq = mgr.get_queue("error")
@@ -428,12 +444,17 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             # FILES-mode worker: run inline; the task slot stays occupied for
             # the duration of training (reference TFSparkNode.py:362-366).
             errq = mgr.get_queue("error")
+            mgr.set("node_pid", os.getpid())
+            hb = reservation.HeartbeatSender(
+                cluster_meta["server_addr"], executor_id,
+                heartbeat_interval).start()
             try:
                 wrapper_fn(tf_args, ctx)
             except Exception:
                 errq.put(traceback.format_exc())
                 raise
             finally:
+                hb.stop()
                 mgr.set("state", "finished")
 
     return _mapfn
@@ -501,6 +522,13 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
             count = sum(1 for _ in iterator)
             logger.info("skipped %d items", count)
         else:
+            # Fast-fail before shipping anything: a consumer that died
+            # WITHOUT signalling (SIGKILL leaves state 'running' forever)
+            # would otherwise absorb the whole partition and then burn
+            # feed_timeout on the drain wait.  The error message is
+            # classified retryable, so a supervised train() can re-feed
+            # this partition to a surviving node.
+            _check_consumer_alive(mgr, executor_id, "before feeding")
             putter = _ChunkPutter(queue, cluster_meta, executor_id, qname,
                                   feed_timeout, cache=(num_epochs > 1))
             count = _feed_blocks(iterator, putter.put, chunk_size)
@@ -517,7 +545,7 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
             # on the in-queue (no-shm-ring) path.
             _join_with_error_check(mgr, queue,
                                    feed_timeout * max(num_epochs, 1),
-                                   "feeding")
+                                   "feeding", executor_id=executor_id)
             logger.info("fed %d items to %s queue", count, qname)
         # If the consumer began terminating while we fed, ask the driver to
         # stop scheduling feed partitions (reference TFSparkNode.py:422-434).
@@ -560,11 +588,14 @@ class _ChunkPutter(object):
 
     def __init__(self, queue, cluster_meta, executor_id, qname, feed_timeout,
                  cache=False):
-        from tensorflowonspark_tpu import shmring
+        from tensorflowonspark_tpu import fault, shmring
 
         self._queue = queue
         self._feed_timeout = feed_timeout
         self._cache = [] if cache else None
+        # Chaos hook: corrupt_chunk_index flips bytes of the Nth serialized
+        # chunk on the ring path (consumer-side unpickle/desync failure).
+        self._injector = fault.from_env()
         # Attach-only: the node process created the ring at startup (run());
         # a feed task must never create one, or a recycled Spark worker's
         # exit would unlink it under the live consumer (see run()).  No ring
@@ -621,7 +652,10 @@ class _ChunkPutter(object):
         if self._ring is not None:
             if data is None:
                 data = pickle.dumps(chunk, protocol=pickle.HIGHEST_PROTOCOL)
-            if self._ring.put_bytes(data, timeout_secs=self._feed_timeout):
+            # Ship possibly-corrupted bytes but cache the CLEAN ones: the
+            # injected fault models one bad transfer, not a poisoned cache.
+            wire = self._injector.corrupt(data)
+            if self._ring.put_bytes(wire, timeout_secs=self._feed_timeout):
                 self._queue.put(marker.ShmChunk(self._ring.name, n),
                                 block=True)
                 return data
@@ -629,9 +663,46 @@ class _ChunkPutter(object):
         return None
 
 
-def _join_with_error_check(mgr, queue, timeout, phase):
+def _check_consumer_alive(mgr, executor_id, when):
+    """Raise (retryably) if the node's user-fn process is gone.
+
+    ``node_pid`` is published by the start task; feeder and node are
+    same-host by construction (the feed task reached this executor via the
+    working-dir handshake), so a 0-signal probe is authoritative.  A missing
+    pid (old node, driver-local) just skips the check.
+    """
+    pid = mgr.get("node_pid")
+    if not pid:
+        return
+    dead = False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        dead = True
+    except OSError:
+        return  # EPERM etc.: process exists but isn't ours — treat as alive
+    if not dead:
+        # The pid exists, but a SIGKILLed node child is a ZOMBIE, not gone:
+        # it's a daemon fork whose spawning start task returned long ago, so
+        # nothing in the executor reaps it and the 0-signal probe keeps
+        # succeeding for the rest of the executor's life.
+        try:
+            with open("/proc/{}/stat".format(pid)) as f:
+                dead = f.read().split(")")[-1].split()[0] == "Z"
+        except OSError:
+            pass  # no procfs (non-Linux): existence is the best signal
+    if dead:
+        raise Exception(
+            "node process (pid {}) on executor {} died {} — it exited "
+            "without consuming its data; check executor logs.".format(
+                pid, executor_id, when))
+
+
+def _join_with_error_check(mgr, queue, timeout, phase, executor_id=None):
     """``queue.join()`` with error-queue polling + timeout (reference
-    ``TFSparkNode.py:407-418``)."""
+    ``TFSparkNode.py:407-418``); also fails fast when the consumer process
+    itself died (an unannounced death would otherwise cost the full
+    ``timeout`` to diagnose)."""
     import threading
 
     joined = threading.Event()
@@ -650,16 +721,43 @@ def _join_with_error_check(mgr, queue, timeout, phase):
     t.start()
     deadline = time.time() + timeout
     errq = mgr.get_queue("error")
+
+    def _surface_user_error():
+        if errq.empty():
+            return
+        # Peek-and-requeue so later lifecycle checks (shutdown's
+        # late-error pass) still observe the failure (reference
+        # TFSparkNode.py:547-553 applies the same trick).
+        trace = errq.get(block=True)
+        errq.task_done()
+        errq.put(trace)
+        raise Exception("Exception in user code during {}:\n{}".format(phase, trace))
+
+    last_pid_check = 0.0
     while not joined.is_set():
-        if not errq.empty():
-            # Peek-and-requeue so later lifecycle checks (shutdown's
-            # late-error pass) still observe the failure (reference
-            # TFSparkNode.py:547-553 applies the same trick).
-            trace = errq.get(block=True)
-            errq.task_done()
-            errq.put(trace)
-            raise Exception("Exception in user code during {}:\n{}".format(phase, trace))
-        if time.time() > deadline:
+        _surface_user_error()
+        now = time.time()
+        if now - last_pid_check >= 1.0:
+            last_pid_check = now
+            # Checked AFTER the error queue: a consumer that raised and
+            # exited must surface its traceback, not a generic death.
+            try:
+                _check_consumer_alive(mgr, executor_id,
+                                      "while draining the {} queue".format(phase))
+            except Exception:
+                # The death verdict races the dying consumer's own
+                # traceback: its errq.put RPC returns once the item is in
+                # the manager's feeder-thread buffer, where empty() (a pipe
+                # poll) can't see it yet — so the process may look dead
+                # while its traceback is still in flight.  Give the
+                # traceback a beat to land; it is the better diagnosis
+                # (user-code errors are fatal, a bare death is retryable).
+                grace = time.time() + 2.0
+                while time.time() < grace:
+                    _surface_user_error()
+                    time.sleep(0.1)
+                raise
+        if now > deadline:
             mgr.set("state", "stopped")
             raise Exception(
                 "Timeout ({}s) waiting for the consumer to drain the {} queue. "
@@ -687,7 +785,8 @@ def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
         queue_in.put(marker.EndPartition(), block=True)
         if count == 0:
             return []
-        _join_with_error_check(mgr, queue_in, feed_timeout, "inference feeding")
+        _join_with_error_check(mgr, queue_in, feed_timeout,
+                               "inference feeding", executor_id=executor_id)
 
         # Collect exactly `count` results: the 1:1 input/output contract
         # (reference TFSparkNode.py:491-500, TFNode.py:160-162).

@@ -40,20 +40,57 @@ TFOS_SERVER_PORT = "TFOS_SERVER_PORT"
 
 _HEADER = struct.Struct(">I")  # 4-byte big-endian length prefix
 
+_UNSET = object()  # sentinel: "use the client's default request timeout"
+
 
 class Reservations(object):
-    """Thread-safe store of node reservations (reference ``reservation.py:29-63``)."""
+    """Thread-safe store of node reservations (reference ``reservation.py:29-63``).
+
+    Registrations are validated: a duplicate node identity or a registration
+    past ``required`` raises ``ValueError`` (the server answers ``ERR``)
+    instead of silently over-filling the roster — a speculatively re-run
+    start task or a stale executor from a prior cluster must not corrupt the
+    rendezvous every healthy node is blocked on.
+    """
 
     def __init__(self, required):
         self.required = required
         self._lock = threading.Condition()
         self._reservations = []
 
+    @staticmethod
+    def _identity(meta):
+        """Node identity for dedupe: (host, executor_id) when the meta
+        carries an executor identity, else the full sorted payload (so
+        bare test metas like ``{"node": 1}`` stay distinct)."""
+        if isinstance(meta, dict) and meta.get("executor_id") is not None:
+            return ("id", meta.get("host"), meta["executor_id"])
+        return ("meta", repr(sorted(meta.items()))
+                if isinstance(meta, dict) else repr(meta))
+
     def add(self, meta):
         with self._lock:
+            key = self._identity(meta)
+            for existing in self._reservations:
+                if self._identity(existing) == key:
+                    raise ValueError(
+                        "duplicate registration for node {} (executors must "
+                        "run exactly one start task each)".format(key[1:]))
+            if len(self._reservations) >= self.required:
+                raise ValueError(
+                    "roster already has {} of {} reservations; rejecting "
+                    "extra registration {}".format(
+                        len(self._reservations), self.required, key[1:]))
             self._reservations.append(meta)
             if self.done():
                 self._lock.notify_all()
+
+    def notify_waiters(self):
+        """Wake every ``wait()``er for an out-of-band re-check (used by the
+        liveness monitor so a dead node unblocks the driver immediately
+        instead of at the next 1 s poll)."""
+        with self._lock:
+            self._lock.notify_all()
 
     def done(self):
         with self._lock:
@@ -111,20 +148,104 @@ class Server(MessageSocket):
     completes (or a client disconnects and retries).
     """
 
-    def __init__(self, count):
+    def __init__(self, count, heartbeat_interval=0, heartbeat_misses=3,
+                 on_dead=None):
+        """Args:
+          count: required number of reservations.
+          heartbeat_interval: expected seconds between node ``HBEAT``s;
+            0 disables liveness monitoring (beats are still accepted).
+          heartbeat_misses: consecutive missed beats before a node is
+            declared dead (deadline = interval × misses).
+          on_dead: optional ``fn(meta, age_secs)`` callback fired once per
+            dead node from the listener thread (the driver wires it to
+            ``tf_status`` latching and backend executor exclusion).
+        """
         assert count > 0
         self.reservations = Reservations(count)
         self.done = False  # set when a STOP was requested (streaming/early-stop)
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_misses = heartbeat_misses
+        self.on_dead = on_dead
         self._stopping = False  # set by stop(): winds the listener down
         self._socket = None
         self._thread = None
+        self._parked = []  # AWAIT connections waiting for roster completion
+        # Liveness state, touched only by the listener thread plus read-only
+        # snapshots below: executor_id -> (last-beat monotonic time, meta).
+        self._beats = {}
+        self._dead = {}  # executor_id -> human-readable death description
+
+    # -- liveness ---------------------------------------------------------
+
+    def dead_nodes(self):
+        """Snapshot of dead-node descriptions, keyed by executor id."""
+        return dict(self._dead)
+
+    def _watch(self, meta):
+        """Start tracking a registered node (registration counts as beat 0,
+        so a node that registers and never beats is still caught)."""
+        if self.heartbeat_interval and isinstance(meta, dict) \
+                and meta.get("executor_id") is not None:
+            self._beats[meta["executor_id"]] = (time.monotonic(), meta)
+
+    def _beat(self, executor_id):
+        """Record a heartbeat; False if the node was already declared dead
+        (the sender is fenced: a zombie must not resurrect silently)."""
+        if executor_id in self._dead:
+            return False
+        if executor_id in self._beats:
+            self._beats[executor_id] = (
+                time.monotonic(), self._beats[executor_id][1])
+        elif self.heartbeat_interval:
+            # beat before/without REG (e.g. a feed task's probe): track it
+            self._beats[executor_id] = (time.monotonic(),
+                                        {"executor_id": executor_id})
+        return True
+
+    def _check_liveness(self):
+        """Listener-loop tick: declare nodes dead past the missed-beat
+        deadline, fire ``on_dead``, and wake roster waiters immediately."""
+        if not self.heartbeat_interval or self.done:
+            return
+        deadline = self.heartbeat_interval * self.heartbeat_misses
+        now = time.monotonic()
+        newly_dead = []
+        for executor_id, (last, meta) in list(self._beats.items()):
+            age = now - last
+            if age > deadline:
+                desc = ("node {}:{} (executor {}) on {} missed {} heartbeats "
+                        "(last beat {:.1f}s ago, interval {:.1f}s)").format(
+                            meta.get("job_name", "?"),
+                            meta.get("task_index", "?"), executor_id,
+                            meta.get("host", "?"), self.heartbeat_misses,
+                            age, self.heartbeat_interval)
+                logger.error("liveness: %s", desc)
+                self._dead[executor_id] = desc
+                del self._beats[executor_id]
+                newly_dead.append((meta, age))
+        if newly_dead:
+            # Wake await_reservations NOW rather than at its next poll.
+            self.reservations.notify_waiters()
+            if self.on_dead is not None:
+                for meta, age in newly_dead:
+                    try:
+                        self.on_dead(meta, age)
+                    except Exception:
+                        logger.exception("on_dead callback failed")
+
+    def _forget(self, executor_id):
+        """Clean deregistration (``BYE``): the node finished on purpose, so
+        silence from here on is not a death."""
+        self._beats.pop(executor_id, None)
 
     def await_reservations(self, status=None, timeout=600):
         """Block the driver until all nodes registered (reference 111-126).
 
         ``status`` is a shared dict; if an async job-launcher thread records an
         ``'error'`` key there, waiting aborts immediately (reference
-        ``reservation.py:117-120`` + ``TFCluster.py:321-323``).
+        ``reservation.py:117-120`` + ``TFCluster.py:321-323``).  A node the
+        liveness monitor declared dead also aborts immediately — a roster
+        that can never complete must not hang for the full timeout.
         """
         deadline = time.time() + timeout
         while not self.reservations.done():
@@ -132,6 +253,10 @@ class Server(MessageSocket):
                 raise Exception(
                     "Cluster startup failed on an executor: {}".format(status["error"])
                 )
+            if self._dead:
+                raise Exception(
+                    "Cluster startup failed: node(s) died during bring-up: "
+                    "{}".format("; ".join(self._dead.values())))
             if time.time() > deadline:
                 raise Exception(
                     "Timed out waiting for cluster reservations after {}s: "
@@ -156,7 +281,29 @@ class Server(MessageSocket):
         """
         mtype = msg.get("type")
         if mtype == "REG":
-            self.reservations.add(msg["data"])
+            try:
+                self.reservations.add(msg["data"])
+            except ValueError as e:
+                logger.warning("rejecting registration: %s", e)
+                self.send(sock, {"type": "ERR", "error": str(e)})
+                return True
+            self._watch(msg["data"])
+            self.send(sock, {"type": "OK"})
+        elif mtype == "HBEAT":
+            executor_id = (msg.get("data") or {}).get("executor_id")
+            if executor_id is None:
+                self.send(sock, {"type": "ERR",
+                                 "error": "HBEAT without executor_id"})
+            elif self._beat(executor_id):
+                self.send(sock, {"type": "OK"})
+            else:
+                self.send(sock, {"type": "ERR",
+                                 "error": "marked dead by the liveness "
+                                          "monitor"})
+        elif mtype == "BYE":
+            executor_id = (msg.get("data") or {}).get("executor_id")
+            if executor_id is not None:
+                self._forget(executor_id)
             self.send(sock, {"type": "OK"})
         elif mtype == "QUERY":
             self.send(sock, {"type": "QUERY", "done": self.reservations.done()})
@@ -195,7 +342,7 @@ class Server(MessageSocket):
 
         def _listen():
             conns = [self._socket]
-            parked = []  # AWAIT connections waiting for roster completion
+            parked = self._parked  # AWAIT conns waiting for roster completion
             # The listener must keep serving after a STOP message (self.done
             # only *signals* streaming termination; later feed tasks still
             # send STOP/QUERY) — only an explicit stop() winds it down.
@@ -218,7 +365,13 @@ class Server(MessageSocket):
                         except (EOFError, OSError, ValueError):
                             keep = False
                         if not keep:
+                            # Drop the fd from BOTH lists: a parked AWAIT
+                            # whose peer disconnected is readable (EOF) and
+                            # lands here — leaving it parked would leak the
+                            # fd until roster completion on long bring-ups.
                             conns.remove(sock)
+                            if sock in parked:
+                                parked.remove(sock)
                             sock.close()
                 if parked and self.reservations.done():
                     info = self.reservations.get()
@@ -227,7 +380,8 @@ class Server(MessageSocket):
                             self.send(sock, {"type": "INFO", "data": info})
                         except OSError:
                             pass
-                    parked = []
+                    del parked[:]
+                self._check_liveness()
 
         self._thread = threading.Thread(
             target=_listen, name="reservation-server", daemon=True
@@ -246,16 +400,29 @@ class Server(MessageSocket):
                 pass
 
 
+#: Default control-plane request timeout.  A finite default matters: with
+#: ``timeout=None`` a ``register()``/``request_stop()`` against a server
+#: process that died mid-request blocks its executor FOREVER (the socket
+#: never EOFs through a half-open NAT path) — the whole cluster then hangs
+#: on one node with no diagnosis.
+DEFAULT_REQUEST_TIMEOUT = 30.0
+
+
 class Client(MessageSocket):
     """Executor-side rendezvous client (reference ``reservation.py:205-272``)."""
 
-    def __init__(self, server_addr, retries=3, retry_delay=1.0):
+    def __init__(self, server_addr, retries=3, retry_delay=1.0,
+                 request_timeout=DEFAULT_REQUEST_TIMEOUT):
         self.server_addr = tuple(server_addr)
         self._retries = retries
         self._retry_delay = retry_delay
+        self._request_timeout = request_timeout
         self._sock = self._connect()
 
     def _connect(self):
+        from tensorflowonspark_tpu import fault
+
+        fault.from_env().delay_socket()
         last = None
         for attempt in range(self._retries + 1):
             sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -273,18 +440,41 @@ class Client(MessageSocket):
             )
         )
 
-    def _request(self, msg, timeout=None):
+    def _request(self, msg, timeout=_UNSET):
+        if timeout is _UNSET:
+            timeout = self._request_timeout
         self._sock.settimeout(timeout)
         try:
             self.send(self._sock, msg)
             return self.receive(self._sock)
+        except socket.timeout:
+            raise TimeoutError(
+                "reservation server at {}:{} did not answer a {} request "
+                "within {}s — the driver process may have died; check the "
+                "driver logs".format(self.server_addr[0], self.server_addr[1],
+                                     msg.get("type"), timeout))
         finally:
             self._sock.settimeout(None)
 
     def register(self, meta):
         """Register this node's metadata (reference ``reservation.py:251-254``)."""
         resp = self._request({"type": "REG", "data": meta})
-        assert resp.get("type") == "OK", "registration failed: {}".format(resp)
+        if resp.get("type") != "OK":
+            raise Exception("registration rejected: {}".format(
+                resp.get("error", resp)))
+
+    def heartbeat(self, executor_id):
+        """Send one liveness beat; returns False if the server fenced this
+        node (declared dead — the caller should stop beating and may choose
+        to self-terminate rather than run as a zombie)."""
+        resp = self._request({"type": "HBEAT",
+                              "data": {"executor_id": executor_id}})
+        return resp.get("type") == "OK"
+
+    def goodbye(self, executor_id):
+        """Clean liveness deregistration: this node is finishing on purpose,
+        so the monitor must not read its silence as a death."""
+        self._request({"type": "BYE", "data": {"executor_id": executor_id}})
 
     def get_reservations(self):
         """Non-blocking roster query; None until complete."""
@@ -330,3 +520,81 @@ class Client(MessageSocket):
             self._sock.close()
         except OSError:
             pass
+
+
+class HeartbeatSender(object):
+    """Daemon thread beating ``HBEAT`` to the reservation server.
+
+    Runs *inside the process executing the user fn* — not the executor shell —
+    so a SIGKILL of the training process silences the beats even though the
+    executor (and its manager) survive; that silence is exactly what the
+    driver-side monitor turns into a dead-node verdict.
+
+    Failure stance: beats are best-effort.  A send error is retried with a
+    fresh connection next tick (the server may be mid-restart); only a fence
+    (``ERR`` answer: the monitor already declared us dead) stops the thread,
+    because continuing to compute as a zombie would race the retried task.
+    A clean ``stop()`` sends ``BYE`` so planned exits aren't counted as deaths.
+    """
+
+    def __init__(self, server_addr, executor_id, interval):
+        self.server_addr = tuple(server_addr)
+        self.executor_id = executor_id
+        self.interval = interval
+        self.fenced = False
+        self._stop = threading.Event()
+        self._client = None
+        self._beats_sent = 0
+        self._thread = threading.Thread(
+            target=self._run, name="heartbeat-sender", daemon=True)
+
+    def start(self):
+        if self.interval:
+            self._thread.start()
+        return self
+
+    def _ensure_client(self):
+        if self._client is None:
+            self._client = Client(self.server_addr, retries=0,
+                                  request_timeout=max(self.interval * 2, 5.0))
+        return self._client
+
+    def _drop_client(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def _run(self):
+        from tensorflowonspark_tpu import fault
+
+        injector = fault.from_env()
+        while not self._stop.wait(self.interval):
+            self._beats_sent += 1
+            if injector.should_drop_heartbeat(self._beats_sent):
+                logger.warning("fault injection: dropping heartbeat %d",
+                               self._beats_sent)
+                continue
+            try:
+                if not self._ensure_client().heartbeat(self.executor_id):
+                    logger.error(
+                        "executor %s fenced by the liveness monitor; "
+                        "stopping heartbeats", self.executor_id)
+                    self.fenced = True
+                    return
+            except Exception as e:
+                logger.warning("heartbeat failed (%s); will retry with a "
+                               "fresh connection", e)
+                self._drop_client()
+
+    def stop(self, goodbye=True):
+        """Stop beating; with ``goodbye`` also deregister from the monitor."""
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=max(self.interval * 2, 5.0))
+        if goodbye and not self.fenced and self.interval:
+            try:
+                self._ensure_client().goodbye(self.executor_id)
+            except Exception as e:
+                logger.warning("BYE failed (%s); the driver may log a "
+                               "spurious dead node", e)
+        self._drop_client()

@@ -11,6 +11,7 @@ from Spark partitions.
 
 import dataclasses
 import logging
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -492,3 +493,70 @@ class Trainer(object):
             return self.history.log_stats(
                 loss=None if last_loss is None else float(last_loss))
         return {}
+
+    def restore_latest(self, ckpt_manager):
+        """Restore the newest checkpoint INTO this trainer's state (same
+        shardings — see :func:`~tensorflowonspark_tpu.checkpoint.abstract_state`);
+        returns the restored step, or None when no checkpoint exists yet.
+        The recovery half of the reference's story "Spark retries the job and
+        TF restores from the last checkpoint" (SURVEY §5.3)."""
+        from tensorflowonspark_tpu import checkpoint as ckpt_mod
+
+        state, step = ckpt_manager.restore_latest(
+            ckpt_mod.abstract_state(self.state))
+        if step is None:
+            return None
+        self.state = state
+        logger.info("trainer state restored at step %d", step)
+        return step
+
+
+def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
+                   max_steps=None, steps_per_call=1):
+    """Supervised :meth:`Trainer.fit_feed`: restore-latest, train with
+    periodic checkpoints, and on a retryable failure back off, re-restore,
+    and try again from the last saved step.
+
+    Args:
+      trainer: a :class:`Trainer` (its current state seeds attempt 1 when no
+        checkpoint exists yet).
+      feed_factory: zero-arg callable returning a FRESH feed per attempt —
+        a feed whose consumer crashed mid-batch cannot be reused (its queue
+        join state is undefined), so supervision owns feed construction.
+      ckpt_manager: a :class:`~tensorflowonspark_tpu.checkpoint.CheckpointManager`;
+        ``maybe_save`` runs after every dispatch and a final ``force`` save
+        lands before returning.
+      retry_policy: a :class:`~tensorflowonspark_tpu.fault.RetryPolicy`
+        (default policy when None).  Only retryable failures re-enter the
+        loop; user-code bugs re-raise immediately.
+      max_steps / steps_per_call: forwarded to :meth:`Trainer.fit_feed`.
+
+    Returns the final fit stats dict.
+    """
+    from tensorflowonspark_tpu import fault as fault_mod
+
+    policy = retry_policy or fault_mod.RetryPolicy()
+    for attempt in range(policy.max_attempts):
+        restored = trainer.restore_latest(ckpt_manager)
+        if restored is not None:
+            logger.info("supervised fit: resuming from checkpoint step %d",
+                        restored)
+        try:
+            stats = trainer.fit_feed(
+                feed_factory(), max_steps=max_steps,
+                steps_per_call=steps_per_call,
+                on_steps=lambda s: ckpt_manager.maybe_save(s, trainer.state))
+            ckpt_manager.maybe_save(int(trainer.state.step), trainer.state,
+                                    force=True)
+            ckpt_manager.wait_until_finished()
+            return stats
+        except Exception as e:
+            if not policy.is_retryable(e) or attempt + 1 >= policy.max_attempts:
+                raise
+            delay = policy.backoff(attempt)
+            logger.warning(
+                "supervised fit attempt %d/%d failed (%s: %s); restoring "
+                "latest checkpoint and retrying in %.1fs", attempt + 1,
+                policy.max_attempts, type(e).__name__, e, delay)
+            time.sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

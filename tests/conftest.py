@@ -54,3 +54,40 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402  (must import after the env staging above)
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """Per-test wall-clock limit for ``chaos``-marked tests.
+
+    Fault-injection tests deliberately kill processes mid-protocol; a
+    recovery bug there presents as a HANG (a feeder blocked on a dead
+    consumer), which would otherwise eat the whole suite's 600s timeout.
+    SIGALRM (not pytest-timeout: not installed here) turns that hang into a
+    stack-bearing failure.  Armed only on the main thread of the main
+    interpreter — SIGALRM can't target worker threads.
+    """
+    import signal
+    import threading
+
+    marker = item.get_closest_marker("chaos")
+    if marker is None or threading.current_thread() is not threading.main_thread():
+        yield
+        return
+    limit = int(marker.kwargs.get("timeout", 120))
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            "chaos test exceeded its {}s wall-clock limit — a recovery path "
+            "is hanging instead of failing".format(limit))
+
+    previous = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.alarm(limit)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, previous)

@@ -1,0 +1,209 @@
+"""Chaos tests: FaultInjector-driven failures against REAL clusters, proving
+the full detect → retry → recover loop (the acceptance path for the
+fault-tolerance subsystem).
+
+Every test here runs under the ``chaos`` marker's SIGALRM wall-clock limit
+(see ``conftest.py``): a broken recovery path presents as a hang, and the
+alarm turns that into a stack-bearing failure instead of a stuck suite.
+"""
+
+import json
+import os
+import random
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import backend, cluster, fault
+from tensorflowonspark_tpu.cluster import InputMode
+
+
+def _node_sum_fn(args, ctx):
+    """Consume this node's feed and persist the running total; the injector
+    (planted via env on exactly one executor) kills the node mid-consumption."""
+    feed = ctx.get_data_feed()
+    total = 0
+    while not feed.should_stop():
+        for x in feed.next_batch(2):
+            total += x
+    with open("sum.txt", "w") as f:
+        f.write(str(total))
+
+
+@pytest.mark.chaos(timeout=180)
+def test_node_killed_mid_feed_is_detected_and_retried():
+    """The flagship end-to-end: SIGKILL one node mid-feed via FaultInjector →
+    the liveness monitor declares it dead within the missed-beat deadline
+    (seconds, not the 600s feed timeout) and fences its executor → the
+    supervised feed job retries the failed partition with backoff onto the
+    surviving executor → its node consumes the retried partition and the run
+    completes with the full dataset accounted for."""
+    spec = json.dumps({"kill_after_items": 5})
+    b = backend.LocalBackend(
+        2, env_per_executor=[{fault.FAULT_SPEC_ENV: spec}, None])
+    try:
+        c = cluster.run(b, _node_sum_fn, tf_args=[], num_executors=2,
+                        input_mode=InputMode.SPARK,
+                        heartbeat_interval=0.5, heartbeat_misses=2)
+        policy = fault.RetryPolicy(max_attempts=5, initial_backoff=1.5,
+                                   multiplier=1.5, jitter=0.3,
+                                   rng=random.Random(7))
+        t0 = time.time()
+        c.train(backend.partition(range(20), 2), retry_policy=policy)
+        elapsed = time.time() - t0
+        # recovery, not the feeder's 600s drain timeout, resolved the death
+        assert elapsed < 90, elapsed
+        # the liveness monitor (not the feed plane) identified WHO died
+        dead = c.tf_status.get("dead_nodes")
+        assert dead and "executor 0" in dead[0], c.tf_status
+        # a recovered run is a SUCCESS: no fatal latch, clean exit 0
+        assert "error" not in c.tf_status
+        c.shutdown(grace_secs=1)
+        # The surviving node consumed its own partition AND the retried one:
+        # nothing of the dataset was lost with the dead node.
+        with open(os.path.join(b.workdir_root, "executor-1",
+                               "sum.txt")) as f:
+            assert int(f.read()) == sum(range(20))
+        # the killed node never completed (its partial file must not exist)
+        assert not os.path.exists(
+            os.path.join(b.workdir_root, "executor-0", "sum.txt"))
+    finally:
+        b.stop()
+
+
+@pytest.mark.chaos(timeout=120)
+def test_injected_user_failure_stays_fatal_despite_retry_policy():
+    """A user-code failure under a retry policy must raise immediately —
+    retrying would re-train on duplicate rows (the classification contract)."""
+    spec = json.dumps({"fail_after_items": 3,
+                       "message": "injected consumer bug"})
+    b = backend.LocalBackend(
+        2, env_per_executor=[{fault.FAULT_SPEC_ENV: spec}, None])
+    try:
+        c = cluster.run(b, _node_sum_fn, tf_args=[], num_executors=2,
+                        input_mode=InputMode.SPARK)
+        policy = fault.RetryPolicy(max_attempts=4, initial_backoff=0.1)
+        t0 = time.time()
+        with pytest.raises(Exception, match="injected consumer bug"):
+            c.train(backend.partition(range(20), 2), feed_timeout=30,
+                    retry_policy=policy)
+        # one attempt, no backoff ladder: fatal means fatal
+        assert time.time() - t0 < 25
+        with pytest.raises(SystemExit):
+            c.shutdown(grace_secs=1)
+    finally:
+        b.stop()
+
+
+class _CrashOnceFeed(object):
+    """Feed wrapper that raises an (opt-in retryable) InjectedFailure after
+    N batches — a feed-plane loss mid-training."""
+
+    def __init__(self, inner, crash_after):
+        self._inner = inner
+        self._crash_after = crash_after
+
+    def batches(self):
+        for i, item in enumerate(self._inner.batches()):
+            if self._crash_after is not None and i >= self._crash_after:
+                self._inner.terminate()
+                fault.fail("injected feed-plane loss")
+            yield item
+
+    def terminate(self):
+        self._inner.terminate()
+
+
+@pytest.mark.chaos(timeout=120)
+def test_fit_supervised_restores_latest_and_completes(tmp_path):
+    """Supervised trainer restart: crash after step 2 of attempt 1 → the
+    supervisor backs off, restores the step-2 checkpoint, and attempt 2
+    finishes the run from there (the reference's "Spark retries the job and
+    TF restores from the last checkpoint" story, SURVEY §5.3)."""
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint as ckpt_mod
+    from tensorflowonspark_tpu import manager
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    from tensorflowonspark_tpu.parallel import build_mesh
+    from tensorflowonspark_tpu.parallel.infeed import ShardedFeed
+    from tensorflowonspark_tpu.train import Trainer, fit_supervised
+
+    mesh = build_mesh()
+    rng = np.random.RandomState(0)
+    rows = [([float(x) for x in rng.rand(2)],) for _ in range(32)]
+    rows = [(r[0], float(np.dot(r[0], [3.14, 1.618]))) for r in rows]
+
+    managers, attempts = [], []
+
+    def feed_factory():
+        # a FRESH feed per attempt: a crashed consumer's queue state is
+        # undefined, so supervision owns feed construction (train.py doc)
+        m = manager.start(b"chaos-fit-%d" % len(managers),
+                          ["input", "output", "error"])
+        managers.append(m)
+        q = m.get_queue("input")
+        for r in rows:
+            q.put(r)
+        q.put(None)
+        feed = DataFeed(m, input_mapping={"a_x": "x", "b_y": "y"})
+        sharded = ShardedFeed(feed, mesh, global_batch_size=8, prefetch=0)
+        attempts.append(1)
+        # only the first attempt crashes (after 2 of its 4 batches)
+        return _CrashOnceFeed(sharded, 2 if len(attempts) == 1 else None)
+
+    def loss(params, batch, mask):
+        pred = jnp.asarray(batch["x"]) @ params["w"]
+        err = (pred - jnp.asarray(batch["y"])) ** 2 * mask
+        return err.sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+    trainer = Trainer(loss, {"w": jnp.zeros((2,))}, optax.sgd(0.05),
+                      mesh=mesh, batch_size=8, log_steps=2)
+    ckpt = ckpt_mod.CheckpointManager(str(tmp_path / "ckpt"),
+                                      save_interval_steps=1)
+    policy = fault.RetryPolicy(max_attempts=3, initial_backoff=0.05,
+                               extra_retryable=["injected"])
+    try:
+        stats = fit_supervised(trainer, feed_factory, ckpt,
+                               retry_policy=policy)
+        assert len(attempts) == 2                     # crashed once, recovered
+        # attempt 1 trained steps 1-2 (checkpointed), attempt 2 restored at
+        # step 2 and consumed its full fresh feed: 4 more steps
+        assert int(trainer.state.step) == 6
+        assert ckpt.latest_step() == 6
+        assert "loss" in stats
+    finally:
+        ckpt.close()
+        for m in managers:
+            m.shutdown()
+
+
+@pytest.mark.chaos(timeout=120)
+def test_fit_supervised_fatal_error_raises_without_retry(tmp_path):
+    """A non-retryable failure inside the supervised loop re-raises on the
+    first attempt (no silent retry ladder around user bugs)."""
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint as ckpt_mod
+    from tensorflowonspark_tpu.train import Trainer, fit_supervised
+
+    calls = []
+
+    def feed_factory():
+        calls.append(1)
+        raise ValueError("user bug in feed construction")
+
+    trainer = Trainer(lambda p, b, m: (jnp.zeros(()), {}),
+                      {"w": jnp.zeros((2,))}, optax.sgd(0.1))
+    ckpt = ckpt_mod.CheckpointManager(str(tmp_path / "ckpt"))
+    try:
+        with pytest.raises(ValueError, match="user bug"):
+            fit_supervised(trainer, feed_factory, ckpt,
+                           retry_policy=fault.RetryPolicy(
+                               max_attempts=5, initial_backoff=0.05))
+        assert len(calls) == 1
+    finally:
+        ckpt.close()

@@ -86,9 +86,11 @@ def test_failure_during_feeding(local_backend):
     with a short feed_timeout (reference ``test_TFCluster.py:50-68``)."""
 
     def map_fun(args, ctx):
+        from tensorflowonspark_tpu import fault
+
         feed = ctx.get_data_feed()
         feed.next_batch(1)
-        raise RuntimeError("injected mid-feed failure")
+        fault.fail("injected mid-feed failure")
 
     c = cluster.run(local_backend, map_fun, tf_args=[], num_executors=2,
                     input_mode=InputMode.SPARK)
@@ -104,10 +106,12 @@ def test_failure_after_feeding(local_backend):
     ``test_TFCluster.py:70-91``)."""
 
     def map_fun(args, ctx):
+        from tensorflowonspark_tpu import fault
+
         feed = ctx.get_data_feed()
         while not feed.should_stop():
             feed.next_batch(5)
-        raise RuntimeError("injected post-feed failure")
+        fault.fail("injected post-feed failure")
 
     c = cluster.run(local_backend, map_fun, tf_args=[], num_executors=2,
                     input_mode=InputMode.SPARK)
@@ -358,9 +362,10 @@ def test_columnar_feed_without_shm_ring():
 
 def test_hard_killed_consumer_surfaces_feed_timeout(local_backend, tmp_path):
     """SIGKILL the training process mid-run (the OOM-killer scenario): it
-    can't push an error through the queue, so the feeder's feed_timeout
-    watchdog must surface the failure to the driver instead of hanging
-    (reference feed_timeout, TFSparkNode.py:410-418)."""
+    can't push an error through the queue, so the feeder must surface the
+    failure to the driver instead of hanging — via the node_pid fast-fail
+    when it catches the death, else the feed_timeout watchdog (reference
+    feed_timeout, TFSparkNode.py:410-418)."""
     import signal
     import time as _time
 
@@ -392,7 +397,7 @@ def test_hard_killed_consumer_surfaces_feed_timeout(local_backend, tmp_path):
             with open(os.path.join(pid_dir, name)) as f:
                 os.kill(int(f.read()), signal.SIGKILL)
 
-    with pytest.raises(Exception, match="Timeout"):
+    with pytest.raises(Exception, match="node process .* died|Timeout"):
         c.train(backend.partition(range(100), 2), feed_timeout=8)
     with pytest.raises(SystemExit):
         c.shutdown(grace_secs=1)

@@ -1,0 +1,169 @@
+"""Unit tests for the fault-tolerance primitives: RetryPolicy
+classification/backoff and the FaultInjector chaos harness (the e2e
+kill/detect/retry paths live in ``test_chaos.py``)."""
+
+import json
+import random
+
+import pytest
+
+from tensorflowonspark_tpu import fault
+
+
+class TestRetryPolicyBackoff:
+    def test_exponential_growth_and_ceiling(self):
+        p = fault.RetryPolicy(initial_backoff=1.0, multiplier=2.0,
+                              max_backoff=5.0, jitter=0)
+        assert [p.backoff(a) for a in range(5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+
+    def test_jitter_samples_within_band(self):
+        p = fault.RetryPolicy(initial_backoff=10.0, multiplier=1.0,
+                              jitter=0.5, rng=random.Random(0))
+        for _ in range(100):
+            d = p.backoff(0)
+            assert 5.0 <= d <= 10.0
+
+    def test_jitter_is_deterministic_with_seeded_rng(self):
+        a = fault.RetryPolicy(rng=random.Random(42))
+        b = fault.RetryPolicy(rng=random.Random(42))
+        assert [a.backoff(i) for i in range(3)] == \
+            [b.backoff(i) for i in range(3)]
+
+    def test_max_attempts_validated(self):
+        with pytest.raises(AssertionError):
+            fault.RetryPolicy(max_attempts=0)
+
+
+class TestRetryPolicyClassification:
+    def test_infrastructure_failures_are_retryable(self):
+        p = fault.RetryPolicy()
+        for msg in [
+            "executor 1 died while running task 3",
+            "node process (pid 123) on executor 0 died before feeding",
+            "task skipped: job cancelled after task 2 failed",
+            "backend stopped",
+            "Timeout (600s) waiting for the consumer on executor 1",
+            "job did not complete within 30s",
+            "node worker:1 (executor 1) on h marked dead by the liveness "
+            "monitor",
+            "ConnectionError: connection refused",
+        ]:
+            assert p.is_retryable(msg), msg
+
+    def test_user_code_failure_is_fatal(self):
+        p = fault.RetryPolicy()
+        assert not p.is_retryable("Exception in user code:\nValueError: bad")
+        # fatal marker overrides an embedded retryable pattern: a user
+        # traceback quoting a ConnectionError must not trigger a retry that
+        # re-feeds consumed rows
+        assert not p.is_retryable(
+            "Exception in user code:\nConnectionError: refused")
+
+    def test_retryable_exception_types(self):
+        p = fault.RetryPolicy()
+        assert p.is_retryable(ConnectionResetError("peer reset"))
+        assert p.is_retryable(EOFError("socket closed"))
+        assert p.is_retryable(BrokenPipeError("pipe"))
+        assert p.is_retryable(TimeoutError("too slow"))
+        assert not p.is_retryable(ValueError("user bug"))
+
+    def test_injected_failure_fatal_by_default_retryable_by_optin(self):
+        err = fault.InjectedFailure("injected mid-feed failure")
+        assert not fault.RetryPolicy().is_retryable(err)
+        assert fault.RetryPolicy(
+            extra_retryable=["injected"]).is_retryable(err)
+
+    def test_retryable_fn_full_override(self):
+        p = fault.RetryPolicy(retryable_fn=lambda e: "flaky" in str(e))
+        assert p.is_retryable(ValueError("flaky widget"))
+        assert not p.is_retryable("executor 1 died")  # patterns skipped
+
+
+class TestRetryPolicyCall:
+    def _policy(self, **kw):
+        kw.setdefault("initial_backoff", 0.01)
+        kw.setdefault("max_backoff", 0.02)
+        return fault.RetryPolicy(**kw)
+
+    def test_retries_retryable_until_success(self):
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ConnectionError("refused")
+            return "ok"
+
+        hook = []
+        assert self._policy(max_attempts=5).call(
+            fn, on_retry=lambda a, e: hook.append(a)) == "ok"
+        assert len(attempts) == 3
+        assert hook == [0, 1]
+
+    def test_exhausted_attempts_reraise_last_error(self):
+        with pytest.raises(ConnectionError):
+            self._policy(max_attempts=2).call(
+                lambda: (_ for _ in ()).throw(ConnectionError("down")))
+
+    def test_non_retryable_raises_immediately(self):
+        attempts = []
+
+        def fn():
+            attempts.append(1)
+            raise ValueError("user bug")
+
+        with pytest.raises(ValueError):
+            self._policy(max_attempts=5).call(fn)
+        assert len(attempts) == 1
+
+
+class TestFaultInjector:
+    def test_fail_after_items_fires_once(self):
+        inj = fault.FaultInjector({"fail_after_items": 3, "message": "boom"})
+        inj.on_items(2)
+        with pytest.raises(fault.InjectedFailure, match="boom"):
+            inj.on_items(1)
+        inj.on_items(10)  # already fired; counter keeps running harmlessly
+
+    def test_corrupt_targets_exact_chunk_index(self):
+        inj = fault.FaultInjector({"corrupt_chunk_index": 1})
+        data = b"x" * 32
+        assert inj.corrupt(data) == data          # chunk 0 passes through
+        mangled = inj.corrupt(data)               # chunk 1 corrupted
+        assert mangled != data and len(mangled) == len(data)
+        assert mangled[16:] == data[16:]          # only the prefix is flipped
+        assert inj.corrupt(data) == data          # chunk 2 passes through
+
+    def test_should_drop_heartbeat_threshold(self):
+        inj = fault.FaultInjector({"drop_heartbeats_after": 2})
+        assert not inj.should_drop_heartbeat(1)
+        assert inj.should_drop_heartbeat(2)
+        assert inj.should_drop_heartbeat(3)
+        assert not fault.NULL.should_drop_heartbeat(99)
+
+    def test_maybe_fail_named_failpoint(self):
+        inj = fault.FaultInjector({"fail_at": "dispatch"})
+        inj.maybe_fail("collect")  # different failpoint: no-op
+        with pytest.raises(fault.InjectedFailure):
+            inj.maybe_fail("dispatch")
+
+    def test_from_env_unset_and_malformed_yield_null(self):
+        assert fault.from_env({}) is fault.NULL
+        assert fault.from_env(
+            {fault.FAULT_SPEC_ENV: "{not json"}) is fault.NULL
+
+    def test_from_env_parses_spec(self):
+        spec = {"kill_after_items": 7}
+        inj = fault.from_env({fault.FAULT_SPEC_ENV: json.dumps(spec)})
+        assert inj.enabled and inj.spec == spec
+
+    def test_from_env_targeted_at_other_executor_yields_null(self, tmp_path,
+                                                             monkeypatch):
+        # this process has no executor-id file in cwd → not the target
+        monkeypatch.chdir(tmp_path)
+        spec = json.dumps({"kill_after_items": 1, "executor_id": 3})
+        assert fault.from_env({fault.FAULT_SPEC_ENV: spec}) is fault.NULL
+
+    def test_fail_helper_raises_injected(self):
+        with pytest.raises(fault.InjectedFailure, match="injected mid"):
+            fault.fail("injected mid-feed failure")

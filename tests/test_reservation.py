@@ -132,3 +132,243 @@ def test_server_await_aborts_on_status_error():
     with pytest.raises(Exception, match="boom"):
         server.await_reservations(status={"error": "boom"}, timeout=5)
     server.stop()
+
+
+# ---------------------------------------------------------------------------
+# registration validation (dedupe / overfill)
+# ---------------------------------------------------------------------------
+
+def test_duplicate_registration_rejected():
+    """A speculatively re-run start task must get ERR, not a roster slot."""
+    server = reservation.Server(2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    meta = {"executor_id": 0, "host": "h", "job_name": "worker",
+            "task_index": 0}
+    client.register(meta)
+    with pytest.raises(Exception, match="duplicate registration"):
+        client.register(dict(meta))
+    assert server.reservations.remaining() == 1  # roster uncorrupted
+    client.close()
+    server.stop()
+
+
+def test_registration_past_required_rejected():
+    """A stale executor from a prior cluster must not over-fill the roster."""
+    server = reservation.Server(1)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0, "host": "h"})
+    with pytest.raises(Exception, match="roster already has"):
+        client.register({"executor_id": 9, "host": "h"})
+    assert len(server.reservations.get()) == 1
+    client.close()
+    server.stop()
+
+
+def test_query_still_answered_after_stop():
+    """Late feed tasks QUERY/QINFO after streaming STOP; the listener must
+    keep serving them, not treat `done` as shutdown."""
+    server = reservation.Server(1)
+    addr = server.start()
+    c1 = reservation.Client(addr)
+    c1.register({"executor_id": 0, "host": "h"})
+    c1.request_stop()
+    c1.close()
+    assert server.done
+    c2 = reservation.Client(addr)
+    assert len(c2.get_reservations()) == 1
+    resp = c2._request({"type": "QUERY"})
+    assert resp == {"type": "QUERY", "done": True}
+    c2.close()
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat liveness
+# ---------------------------------------------------------------------------
+
+def _register_worker(client, executor_id=0):
+    meta = {"executor_id": executor_id, "host": "hostA",
+            "job_name": "worker", "task_index": executor_id}
+    client.register(meta)
+    return meta
+
+
+def test_heartbeat_accepted_and_keeps_node_alive():
+    server = reservation.Server(2, heartbeat_interval=0.2, heartbeat_misses=2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    _register_worker(client)
+    deadline = time.time() + 1.2  # 3x the 0.4s missed-beat deadline
+    while time.time() < deadline:
+        assert client.heartbeat(0)
+        time.sleep(0.1)
+    assert server.dead_nodes() == {}
+    client.close()
+    server.stop()
+
+
+def test_missed_beats_mark_node_dead_with_identity():
+    server = reservation.Server(2, heartbeat_interval=0.2, heartbeat_misses=2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    _register_worker(client)  # registration seeds beat 0; then silence
+    deadline = time.time() + 5
+    while not server.dead_nodes() and time.time() < deadline:
+        time.sleep(0.05)
+    dead = server.dead_nodes()
+    assert list(dead) == [0]
+    # the driver-facing description names the node, not just a socket
+    assert "worker:0" in dead[0] and "executor 0" in dead[0]
+    assert "hostA" in dead[0] and "missed 2 heartbeats" in dead[0]
+    client.close()
+    server.stop()
+
+
+def test_await_reservations_aborts_on_dead_node():
+    """A roster that can never complete (a registrant died during bring-up)
+    must fail the driver immediately with the dead node's identity, not
+    burn the full rendezvous timeout."""
+    server = reservation.Server(2, heartbeat_interval=0.2, heartbeat_misses=2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    _register_worker(client)  # 1 of 2 registered, then goes silent
+    t0 = time.time()
+    with pytest.raises(Exception, match="died during bring-up.*worker:0"):
+        server.await_reservations(timeout=30)
+    assert time.time() - t0 < 10  # aborted on death, not the 30s timeout
+    client.close()
+    server.stop()
+
+
+def test_heartbeat_after_death_is_fenced():
+    """A zombie (marked dead, then beats again) must get ERR so it stops
+    computing rather than racing its replacement."""
+    server = reservation.Server(2, heartbeat_interval=0.1, heartbeat_misses=2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    _register_worker(client)
+    deadline = time.time() + 5
+    while not server.dead_nodes() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not client.heartbeat(0)  # fenced
+    client.close()
+    server.stop()
+
+
+def test_bye_prevents_spurious_death():
+    """A node that finishes cleanly sends BYE; its silence afterwards must
+    not be declared a death."""
+    server = reservation.Server(2, heartbeat_interval=0.1, heartbeat_misses=2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    _register_worker(client)
+    client.goodbye(0)
+    time.sleep(0.5)  # well past the 0.2s missed-beat deadline
+    assert server.dead_nodes() == {}
+    client.close()
+    server.stop()
+
+
+def test_heartbeat_sender_keeps_node_alive_then_bye():
+    server = reservation.Server(2, heartbeat_interval=0.1, heartbeat_misses=3)
+    addr = server.start()
+    client = reservation.Client(addr)
+    _register_worker(client)
+    sender = reservation.HeartbeatSender(addr, 0, interval=0.1).start()
+    time.sleep(1.0)  # 3x the deadline: only the sender keeps node 0 alive
+    assert server.dead_nodes() == {}
+    sender.stop()  # clean exit: BYE deregisters
+    time.sleep(0.5)
+    assert server.dead_nodes() == {}
+    assert not sender.fenced
+    client.close()
+    server.stop()
+
+
+def test_heartbeat_sender_dropped_beats_trigger_death(monkeypatch):
+    """FaultInjector drop_heartbeats_after: the process lives but goes
+    silent — exactly the partition/hang case the monitor must catch."""
+    import json
+
+    from tensorflowonspark_tpu import fault
+
+    monkeypatch.setenv(fault.FAULT_SPEC_ENV,
+                       json.dumps({"drop_heartbeats_after": 1}))
+    server = reservation.Server(2, heartbeat_interval=0.1, heartbeat_misses=3)
+    addr = server.start()
+    client = reservation.Client(addr)
+    _register_worker(client)
+    sender = reservation.HeartbeatSender(addr, 0, interval=0.1).start()
+    deadline = time.time() + 5
+    while not server.dead_nodes() and time.time() < deadline:
+        time.sleep(0.05)
+    assert 0 in server.dead_nodes()
+    sender.stop(goodbye=False)
+    client.close()
+    server.stop()
+
+
+def test_interval_zero_disables_monitoring():
+    server = reservation.Server(2)  # heartbeat_interval defaults to 0
+    addr = server.start()
+    client = reservation.Client(addr)
+    _register_worker(client)
+    assert client.heartbeat(0)  # beats still accepted
+    time.sleep(0.5)
+    assert server.dead_nodes() == {}
+    sender = reservation.HeartbeatSender(addr, 0, interval=0).start()
+    assert not sender._thread.is_alive()  # no-op sender
+    sender.stop()
+    client.close()
+    server.stop()
+
+
+# ---------------------------------------------------------------------------
+# connection hygiene
+# ---------------------------------------------------------------------------
+
+def test_parked_await_pruned_on_disconnect():
+    """An AWAIT long-poller whose peer died must be dropped from the parked
+    list (fd leak + send-to-dead-socket at roster completion otherwise)."""
+    server = reservation.Server(2)
+    addr = server.start()
+    waiter = reservation.Client(addr)
+    waiter.send(waiter._sock, {"type": "AWAIT"})  # park without blocking
+    deadline = time.time() + 5
+    while not server._parked and time.time() < deadline:
+        time.sleep(0.05)
+    assert len(server._parked) == 1
+    waiter.close()  # peer disconnects while parked
+    deadline = time.time() + 5
+    while server._parked and time.time() < deadline:
+        time.sleep(0.05)
+    assert server._parked == []
+    server.stop()
+
+
+def test_client_request_times_out_with_clear_error():
+    """A server process that accepted the connection then wedged (or died
+    behind NAT) must fail the request with a finite, descriptive timeout —
+    not block the executor forever."""
+    import socket as socket_mod
+    import threading
+
+    wedge = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+    wedge.bind(("127.0.0.1", 0))
+    wedge.listen(1)
+    addr = wedge.getsockname()
+    held = []
+    t = threading.Thread(  # accept, read nothing, answer nothing
+        target=lambda: held.append(wedge.accept()), daemon=True)
+    t.start()
+    try:
+        client = reservation.Client(addr, request_timeout=0.5)
+        with pytest.raises(TimeoutError, match="did not answer a QINFO "
+                                               "request within 0.5s"):
+            client.get_reservations()
+        client.close()
+    finally:
+        wedge.close()
+    assert reservation.DEFAULT_REQUEST_TIMEOUT == 30.0  # finite by default

@@ -67,18 +67,22 @@ def _square_fn(args, ctx):
 
 
 def _fail_during_feed_fn(args, ctx):
+    from tensorflowonspark_tpu import fault
+
     feed = ctx.get_data_feed(train_mode=False)
     feed.next_batch(1)
-    raise RuntimeError("injected mid-feed failure")
+    fault.fail("injected mid-feed failure")
 
 
 def _fail_after_feed_fn(args, ctx):
+    from tensorflowonspark_tpu import fault
+
     feed = ctx.get_data_feed()
     while not feed.should_stop():
         if not feed.next_batch(4):
             break
     time.sleep(1)  # let the feeder's queue.join win; this error is LATE
-    raise RuntimeError("injected post-feed failure")
+    fault.fail("injected post-feed failure")
 
 
 class TestSparkCluster:

@@ -471,6 +471,7 @@ def feedplane_main(args, ctx):
             marks.append((rows, time.time()))
             next_mark += window
     elapsed = time.time() - t0
+    wire_formats = dict(getattr(feed, "wire_formats", None) or {})
     feed.terminate()
     rates = []
     prev_rows, prev_t = 0, t0
@@ -483,7 +484,10 @@ def feedplane_main(args, ctx):
              "window_rows": window, "runs": len(rates),
              "stdev": float(np.std(rates)) if rates else None,
              "loadavg": [load0, os.getloadavg()[0]],
-             "epochs": args.epochs}
+             "epochs": args.epochs,
+             # chunk counts per transport encoding ("colv1"/"pickle"/"queue"),
+             # so the artifact records which wire path the rate measures
+             "wire_formats": wire_formats}
     with open(args.stats_path, "w") as f:
         json.dump(stats, f)
     return stats
@@ -794,6 +798,12 @@ def main():
         "metric": "resnet50_train_mfu",
         "value": round(resnet["mfu"], 4) if resnet else None,
         "unit": "mfu",
+        # provenance of the headline number itself: `replayed_legs` lists
+        # every replayed leg, but a reader scanning only the top-level
+        # metric/value pair needs the tag right next to it
+        "value_source": (
+            ("replayed" if "resnet" in replayed else "measured")
+            if resnet else None),
         "resnet50_step_time_ms": round(1000 * resnet["avg_step_seconds"], 2)
         if resnet else None,
         "resnet50_images_per_sec_per_chip": round(
@@ -842,6 +852,10 @@ def main():
             # mean amortizes — without it a cross-round rate delta can't
             # be told apart from a config change
             "epochs": feedplane.get("epochs")}
+        # which wire encoding the chunks actually took (colv1 frames vs
+        # pickled ring records vs in-queue fallback) — a throughput delta
+        # across rounds means nothing without knowing the transport changed
+        out["feed_plane_wire_formats"] = feedplane.get("wire_formats")
         if ceiling:
             out["feed_plane_vs_baseline"] = round(
                 feedplane["items_per_sec"] / ceiling["items_per_sec"], 2)
@@ -860,6 +874,8 @@ def main():
             out["metric"] = "mnist_e2e_train_images_per_sec_per_chip"
             out["value"] = round(ips, 1)
             out["unit"] = "images/sec/chip"
+            out["value_source"] = ("replayed" if "mnist" in replayed
+                                   else "measured")
     for name, err in (("resnet50_error", resnet_err),
                       ("mnist_error", mnist_err),
                       ("transformer_error", lm_err),

@@ -61,6 +61,48 @@ uint64_t now_ms() {
   return static_cast<uint64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
 }
 
+// Block until a contiguous `len`-byte record (plus its u32 length header)
+// fits, laying down a wrap marker when the record would straddle the end.
+// On success, *head_out is the pre-advance head and *wpos_out the record's
+// offset in the data region; the caller writes [len32][payload] there and
+// publishes with a release store of head_out + len + 4.  Returns 0, or the
+// shmring_write error codes (-1 timeout, -2 closed, -3 can never fit).
+int reserve_record(Ring* r, uint64_t len, uint64_t timeout_ms,
+                   uint64_t* head_out, uint64_t* wpos_out) {
+  Header* h = r->hdr;
+  if (len >= kWrapMarker) return -3;  // length header is 32-bit framing
+  const uint64_t need = len + 4;
+  if (need + 4 > r->capacity) return -3;  // +4: worst-case wrap marker
+  const uint64_t deadline = timeout_ms ? now_ms() + timeout_ms : 0;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  unsigned spins = 0;
+  for (;;) {
+    if (h->closed.load(std::memory_order_acquire)) return -2;
+    const uint64_t tail = h->tail.load(std::memory_order_acquire);
+    const uint64_t pos = head % r->capacity;
+    const uint64_t to_end = r->capacity - pos;
+    // Reserve a wrap marker too when the record would straddle the end.
+    const uint64_t reserve = (to_end < need) ? to_end + need : need;
+    if (reserve > r->capacity) return -3;  // can never fit at THIS offset:
+                                           // caller takes the queue fallback
+                                           // rather than starving forever
+    if (head + reserve - tail <= r->capacity) {
+      if (to_end < need) {
+        if (to_end >= 4) {
+          uint32_t wrap = kWrapMarker;
+          memcpy(r->data + pos, &wrap, 4);
+        }  // < 4 bytes left: reader detects the short tail itself
+        head += to_end;  // jump to start of ring
+      }
+      *head_out = head;
+      *wpos_out = head % r->capacity;
+      return 0;
+    }
+    if (deadline && now_ms() > deadline) return -1;
+    backoff(spins++);
+  }
+}
+
 }  // namespace
 
 extern "C" {
@@ -138,41 +180,38 @@ void* shmring_attach(const char* name) {
 int shmring_write(void* handle, const uint8_t* buf, uint64_t len,
                   uint64_t timeout_ms) {
   Ring* r = static_cast<Ring*>(handle);
-  Header* h = r->hdr;
-  if (len >= kWrapMarker) return -3;  // length header is 32-bit framing
-  const uint64_t need = len + 4;
-  if (need + 4 > r->capacity) return -3;  // +4: worst-case wrap marker
-  const uint64_t deadline = timeout_ms ? now_ms() + timeout_ms : 0;
-  uint64_t head = h->head.load(std::memory_order_relaxed);
-  unsigned spins = 0;
-  for (;;) {
-    if (h->closed.load(std::memory_order_acquire)) return -2;
-    const uint64_t tail = h->tail.load(std::memory_order_acquire);
-    const uint64_t pos = head % r->capacity;
-    const uint64_t to_end = r->capacity - pos;
-    // Reserve a wrap marker too when the record would straddle the end.
-    const uint64_t reserve = (to_end < need) ? to_end + need : need;
-    if (reserve > r->capacity) return -3;  // can never fit at THIS offset:
-                                           // caller takes the queue fallback
-                                           // rather than starving forever
-    if (head + reserve - tail <= r->capacity) {
-      if (to_end < need) {
-        if (to_end >= 4) {
-          uint32_t wrap = kWrapMarker;
-          memcpy(r->data + pos, &wrap, 4);
-        }  // < 4 bytes left: reader detects the short tail itself
-        head += to_end;  // jump to start of ring
-      }
-      const uint64_t wpos = head % r->capacity;
-      uint32_t len32 = static_cast<uint32_t>(len);
-      memcpy(r->data + wpos, &len32, 4);
-      memcpy(r->data + wpos + 4, buf, len);
-      h->head.store(head + need, std::memory_order_release);
-      return 0;
-    }
-    if (deadline && now_ms() > deadline) return -1;
-    backoff(spins++);
+  uint64_t head, wpos;
+  const int rc = reserve_record(r, len, timeout_ms, &head, &wpos);
+  if (rc != 0) return rc;
+  uint32_t len32 = static_cast<uint32_t>(len);
+  memcpy(r->data + wpos, &len32, 4);
+  memcpy(r->data + wpos + 4, buf, len);
+  r->hdr->head.store(head + len + 4, std::memory_order_release);
+  return 0;
+}
+
+// Gather-write ONE record from `nbufs` buffers (the zero-copy columnar
+// frame path: header + each column's raw buffer, one memcpy per buffer
+// straight into the ring — no intermediate serialization buffer).  Same
+// return codes as shmring_write.
+int shmring_writev(void* handle, const uint8_t* const* bufs,
+                   const uint64_t* lens, uint64_t nbufs,
+                   uint64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(handle);
+  uint64_t len = 0;
+  for (uint64_t i = 0; i < nbufs; ++i) len += lens[i];
+  uint64_t head, wpos;
+  const int rc = reserve_record(r, len, timeout_ms, &head, &wpos);
+  if (rc != 0) return rc;
+  uint32_t len32 = static_cast<uint32_t>(len);
+  memcpy(r->data + wpos, &len32, 4);
+  uint64_t off = wpos + 4;
+  for (uint64_t i = 0; i < nbufs; ++i) {
+    memcpy(r->data + off, bufs[i], lens[i]);
+    off += lens[i];
   }
+  r->hdr->head.store(head + len + 4, std::memory_order_release);
+  return 0;
 }
 
 // Size of the next record: >=0, -1 on timeout, -2 if closed and drained.
@@ -203,6 +242,35 @@ int64_t shmring_next_len(void* handle, uint64_t timeout_ms) {
     if (deadline && now_ms() > deadline) return -1;
     backoff(spins++);
   }
+}
+
+// Two-phase zero-copy read, phase 1: block like shmring_next_len, then
+// expose a pointer to the next record's payload IN the ring (records never
+// straddle the wrap, so the payload is always contiguous).  The record
+// stays owned by the ring: the consumer copies what it needs out of *out
+// and then calls shmring_consume to release the space — dereferencing the
+// pointer after consume races the producer's overwrite.  Returns the
+// payload length, -1 on timeout, -2 if closed and drained.
+int64_t shmring_peek(void* handle, uint64_t timeout_ms,
+                     const uint8_t** out) {
+  const int64_t n = shmring_next_len(handle, timeout_ms);
+  if (n < 0) return n;
+  Ring* r = static_cast<Ring*>(handle);
+  const uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  *out = r->data + (tail % r->capacity) + 4;
+  return n;
+}
+
+// Two-phase zero-copy read, phase 2: advance past the record exposed by
+// shmring_peek (shmring_pop without the copy), releasing its bytes back to
+// the producer.
+void shmring_consume(void* handle) {
+  Ring* r = static_cast<Ring*>(handle);
+  Header* h = r->hdr;
+  const uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint32_t len32;
+  memcpy(&len32, r->data + tail % r->capacity, 4);
+  h->tail.store(tail + 4 + len32, std::memory_order_release);
 }
 
 // Copy the next record into out (caller sized it via shmring_next_len) and

@@ -22,11 +22,21 @@ def main():
     ap.add_argument("--require-pjrt", action="store_true")
     args = ap.parse_args()
 
-    from tensorflowonspark_tpu import native, tfrecord
+    from tensorflowonspark_tpu import native, shmring, tfrecord
 
     assert tfrecord._lib() is not None, "C++ tfrecord codec not built"
-    assert native.load("shmring") is not None, "C++ shm ring not built"
-    print("native engines OK: tfrecord, shmring")
+    # go through shmring's own loader (it carries the librt link flag for
+    # pre-glibc-2.34 hosts) — a bare native.load here could cache a handle
+    # built without it
+    ring_lib = shmring._lib()
+    assert ring_lib is not None, "C++ shm ring not built"
+    # the zero-copy columnar feed path needs the vectored-write and
+    # two-phase read entry points; an older cached .so without them would
+    # silently demote every ColChunk to the pickled path
+    for sym in ("shmring_writev", "shmring_peek", "shmring_consume"):
+        assert hasattr(ring_lib, sym), \
+            "libshmring.so missing symbol {} (stale build?)".format(sym)
+    print("native engines OK: tfrecord, shmring (+writev/peek/consume)")
     if args.require_pjrt:
         dirs = native.pjrt_include_dirs()
         assert dirs, "pjrt_c_api.h not found (tensorflow wheel missing?)"

@@ -53,9 +53,9 @@ VARIANTS = {
     "seq4096": {"seq": 4096, "batch_size": 2},
     # pallas FlashAttention-2 instead of full causal attention: skips the
     # masked half of the S^2 score work and never materializes the S x S
-    # matrix.  Compare on ms_per_step/tokens_per_sec, NOT mfu_pct -- the
-    # kernel is a custom call XLA's cost analysis can't see into, so its
-    # FLOPs vanish from the MFU numerator
+    # matrix.  mfu_pct IS comparable with the other rungs: the kernel is a
+    # custom call XLA's cost analysis can't see into, so build_lm_trainer
+    # supplements the analytic attention FLOPs via extra_step_flops
     "flash": {"attention": "flash"},
     # top-k gated MoE FFN (8 experts, GSPMD layer; experts local on one
     # chip): what the grouped expert einsums cost vs the dense MLP --

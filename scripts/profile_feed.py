@@ -114,6 +114,46 @@ if shmring.available():
         ring.get_bytes(10)
     t1 = time.perf_counter()
     report("E2 shm ring rt (columnar bytes)", t1-t0, 64*CHUNK)
+
+    # E3. colv1 frame: vectored gather-write + two-phase peek/decode/consume
+    # (same payload as E2 but no pickle and no pop-side staging buffer)
+    from tensorflowonspark_tpu import wire
+    colchunks = [marker.ColChunk(
+        (np.stack([r[0] for r in b]),
+         np.asarray([r[1] for r in b], np.int64)), CHUNK, True)
+        for b in blocks[:64]]
+    t0 = time.perf_counter()
+    for i in range(64):
+        ring.put_vectored(wire.encode_chunk(colchunks[i]), timeout_secs=10)
+        ck = wire.decode_chunk(ring.peek(10), copy=True)
+        ring.consume()
+    t1 = time.perf_counter()
+    report("E3 shm ring rt (colv1 writev/peek)", t1-t0, 64*CHUNK)
+
+    # G/G2. the acceptance comparison — full hop, rows in to batch columns
+    # out (pack -> write -> read -> assemble), pickled vs framed
+    t0 = time.perf_counter()
+    for i in range(64):
+        ck = marker.pack_columnar(blocks[i % len(blocks)])
+        ring.put_bytes(pickle.dumps(ck, protocol=pickle.HIGHEST_PROTOCOL),
+                       timeout_secs=10)
+        out = pickle.loads(ring.get_bytes(10))
+        imgs, labs = out.columns
+    pickled_secs = time.perf_counter() - t0
+    report("G pickled full hop (pack+dumps+ring+loads)", pickled_secs,
+           64*CHUNK)
+    t0 = time.perf_counter()
+    for i in range(64):
+        ck = marker.pack_columnar(blocks[i % len(blocks)])
+        ring.put_vectored(wire.encode_chunk(ck), timeout_secs=10)
+        out = wire.decode_chunk(ring.peek(10), copy=True)
+        ring.consume()
+        imgs, labs = out.columns
+    framed_secs = time.perf_counter() - t0
+    report("G2 framed full hop (pack+writev+decode)", framed_secs, 64*CHUNK)
+    print(f"   framed vs pickled full ring hop: "
+          f"{pickled_secs/framed_secs:.2f}x")
+
     shmring.unlink("profring")
 else:
     print("shmring unavailable")

@@ -113,6 +113,12 @@ class DataFeed(object):
         self._buffer = []
         self._buffer_idx = 0
         self._chunk_q = None
+        # Transport observability: {format: chunks seen} — wire.WIRE_COLV1
+        # for zero-copy framed ring records, wire.WIRE_PICKLE for pickled
+        # ring records, "queue" for in-queue chunks.  The bench feedplane
+        # leg publishes this so a throughput number always names the wire
+        # format that produced it.
+        self.wire_formats = {}
         # Set by interrupt(): unblocks a next_batch blocked on the queue so
         # another thread can take over queue consumption (the queue/ring is
         # single-consumer; see ShardedFeed.terminate).
@@ -152,6 +158,8 @@ class DataFeed(object):
                     # Payload took the native shm-ring fast path; the token
                     # preserves ordering/join semantics (see marker.ShmChunk).
                     item = self._ring_read(item)
+                elif isinstance(item, (marker.Chunk, marker.ColChunk)):
+                    self._note_transport("queue")
                 if isinstance(item, (marker.Chunk, marker.ColChunk)):
                     # Buffer the chunk (item list or columnar); ack deferred
                     # (see ctor).
@@ -229,21 +237,41 @@ class DataFeed(object):
             self._chunk_q.task_done()
             self._chunk_q = None
 
+    def _note_transport(self, fmt):
+        self.wire_formats[fmt] = self.wire_formats.get(fmt, 0) + 1
+
     def _ring_read(self, token, timeout_secs=600):
         """Pop one chunk payload from the shm ring named by the token;
         returns the chunk object (:class:`~tensorflowonspark_tpu.marker.Chunk`
         or :class:`~tensorflowonspark_tpu.marker.ColChunk`; legacy payloads
-        may be bare item lists, returned wrapped in a Chunk)."""
+        may be bare item lists, returned wrapped in a Chunk).
+
+        ``fmt`` on the token picks the record decoding: framed columnar
+        records (:data:`~tensorflowonspark_tpu.wire.WIRE_COLV1`) take the
+        two-phase peek/consume path — the in-ring bytes are wrapped with
+        ``np.frombuffer`` views and each column is copied exactly once into
+        the chunk, with no intermediate record buffer and no unpickle."""
         import pickle
 
-        from tensorflowonspark_tpu import shmring
+        from tensorflowonspark_tpu import shmring, wire
 
         ring = shmring.get_ring(token.ring_name)
         if ring is None:
             raise RuntimeError(
                 "feeder sent a shm-ring chunk but ring {} cannot be attached "
                 "in the consumer process".format(token.ring_name))
-        obj = pickle.loads(ring.get_bytes(timeout_secs))
+        fmt = getattr(token, "fmt", wire.WIRE_PICKLE)
+        if fmt == wire.WIRE_COLV1:
+            view = ring.peek(timeout_secs)
+            try:
+                obj = wire.decode_chunk(view, copy=True)
+            finally:
+                # Consume even when decode raises: tokens and records must
+                # stay 1:1 or every later chunk on this ring desyncs.
+                ring.consume()
+        else:
+            obj = pickle.loads(ring.get_bytes(timeout_secs))
+        self._note_transport(fmt)
         if isinstance(obj, list):
             obj = marker.Chunk(obj)
         n = obj.count if isinstance(obj, marker.ColChunk) else len(obj.items)
@@ -311,6 +339,8 @@ class DataFeed(object):
                 break
             if isinstance(item, marker.ShmChunk):
                 item = self._ring_read(item)
+            elif isinstance(item, (marker.Chunk, marker.ColChunk)):
+                self._note_transport("queue")
             if isinstance(item, (marker.Chunk, marker.ColChunk)):
                 self._buffer = (item.items if isinstance(item, marker.Chunk)
                                 else item)

@@ -39,14 +39,19 @@ class ShmChunk(Marker):
     shared-memory ring (:mod:`~tensorflowonspark_tpu.shmring`) instead of
     the manager socket.  The token keeps the JoinableQueue semantics
     (ordering, backpressure, join/fail-fast) while the bytes take the fast
-    path; ``count`` is the number of items in the ring record.
+    path; ``count`` is the number of items in the ring record and ``fmt``
+    names the in-ring record encoding so the consumer knows how to read it:
+    :data:`~tensorflowonspark_tpu.wire.WIRE_PICKLE` (pickled chunk object)
+    or :data:`~tensorflowonspark_tpu.wire.WIRE_COLV1` (self-describing
+    zero-copy columnar frame, read via the two-phase peek/consume path).
     """
 
-    __slots__ = ("ring_name", "count")
+    __slots__ = ("ring_name", "count", "fmt")
 
-    def __init__(self, ring_name, count):
+    def __init__(self, ring_name, count, fmt="pickle"):
         self.ring_name = ring_name
         self.count = count
+        self.fmt = fmt
 
 
 class ColChunk(Marker):

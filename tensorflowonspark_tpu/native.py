@@ -22,17 +22,24 @@ _lock = threading.Lock()
 _cache = {}
 
 
-def _compile(src, out, flags, timeout):
+def _compile(src, out, flags, timeout, libs=()):
     """Compile ``src`` -> ``out`` when missing/stale.  Compiles to a private
     temp file, then atomically renames: many executor processes race this
     build on one host, and dlopen/exec of a half-written binary would
-    permanently demote that process to its fallback path."""
+    permanently demote that process to its fallback path.
+
+    ``libs`` go AFTER the source on the command line: with the default
+    ``--as-needed`` link order, a ``-l`` before the object that needs it is
+    silently dropped — the .so builds but dlopen later fails on the
+    unresolved symbol (how ``shm_open``/librt demoted pre-glibc-2.34 hosts
+    to the fallback path)."""
     stale = (not os.path.exists(out)
              or os.path.getmtime(out) < os.path.getmtime(src))
     if not stale:
         return
     tmp = "{}.tmp.{}".format(out, os.getpid())
-    cmd = ["g++", "-O3", "-std=c++17"] + list(flags) + ["-o", tmp, src]
+    cmd = (["g++", "-O3", "-std=c++17"] + list(flags) + ["-o", tmp, src]
+           + ["-l" + l for l in libs])
     logger.info("building native code: %s", " ".join(cmd))
     subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
     os.replace(tmp, out)
@@ -56,9 +63,8 @@ def build_executable(name, include_dirs=(), libs=("dl",), timeout=240):
             src = os.path.join(_NATIVE_DIR, name + ".cc")
             exe = os.path.join(_NATIVE_DIR, name)
             if os.path.exists(src):
-                flags = (["-I" + d for d in include_dirs]
-                         + ["-l" + l for l in libs])
-                _compile(src, exe, flags, timeout)
+                flags = ["-I" + d for d in include_dirs]
+                _compile(src, exe, flags, timeout, libs=libs)
                 out = exe
         except Exception:
             logger.warning("native executable %s unavailable", name,
@@ -68,7 +74,7 @@ def build_executable(name, include_dirs=(), libs=("dl",), timeout=240):
         return out
 
 
-def build_shared(name, include_dirs=(), timeout=240, sources=None):
+def build_shared(name, include_dirs=(), timeout=240, sources=None, libs=()):
     """Build ``native/<name>.cc`` into ``native/lib<name>.so`` and return
     the PATH (not a loaded handle — for libraries someone else dlopens,
     like a PJRT plugin), or None when the toolchain/headers are absent."""
@@ -83,7 +89,8 @@ def build_shared(name, include_dirs=(), timeout=240, sources=None):
             if os.path.exists(src):
                 _compile(src, so,
                          ["-shared", "-fPIC"]
-                         + ["-I" + d for d in include_dirs], timeout)
+                         + ["-I" + d for d in include_dirs], timeout,
+                         libs=libs)
                 out = so
         except Exception:
             logger.warning("native shared lib %s unavailable", name,
@@ -109,14 +116,14 @@ def pjrt_include_dirs():
                 "pjrt_c_api.h"))]
 
 
-def load(name, sources=None):
+def load(name, sources=None, libs=()):
     """Load ``lib<name>.so``, building it from ``native/<name>.cc`` first if
     missing or stale (via :func:`build_shared`); returns a ``ctypes.CDLL``
     or None on any failure."""
     with _lock:
         if name in _cache:
             return _cache[name]
-    so = build_shared(name, timeout=120, sources=sources)
+    so = build_shared(name, timeout=120, sources=sources, libs=libs)
     with _lock:
         if name in _cache:  # lost a race with another loader
             return _cache[name]

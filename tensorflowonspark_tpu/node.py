@@ -575,20 +575,24 @@ def _feed_blocks(iterator, put, chunk_size):
 
 
 class _ChunkPutter(object):
-    """Sends item blocks the fastest way available: columnar-packed payload
-    through the native shm ring with an ordering token on the queue, or an
-    in-queue chunk when the ring is unavailable / the record is oversized
-    (see :mod:`~tensorflowonspark_tpu.shmring`).
+    """Sends item blocks the fastest way available: columnar payloads as
+    zero-copy framed records through the native shm ring
+    (:mod:`~tensorflowonspark_tpu.wire` + ``Ring.put_vectored`` — one
+    memcpy per column, no intermediate pickle bytes) with an ordering token
+    on the queue; pickled ring records for object chunks and non-framable
+    columns; an in-queue chunk when the ring is unavailable / the record is
+    oversized (see :mod:`~tensorflowonspark_tpu.shmring`).
 
-    With ``cache=True`` every block's packed chunk (and its serialized
-    bytes, when the ring path was taken) is retained so
+    With ``cache=True`` every block's packed chunk (or its pickled bytes,
+    when the pickled ring path was taken — framed chunks ARE their own raw
+    buffers, so the chunk object is the cache) is retained so
     :meth:`reput_cached` can replay the whole partition without touching
     the source rows again — the executor-side epoch repeat.
     """
 
     def __init__(self, queue, cluster_meta, executor_id, qname, feed_timeout,
                  cache=False):
-        from tensorflowonspark_tpu import fault, shmring
+        from tensorflowonspark_tpu import fault, shmring, wire
 
         self._queue = queue
         self._feed_timeout = feed_timeout
@@ -596,6 +600,12 @@ class _ChunkPutter(object):
         # Chaos hook: corrupt_chunk_index flips bytes of the Nth serialized
         # chunk on the ring path (consumer-side unpickle/desync failure).
         self._injector = fault.from_env()
+        # Framed columnar records unless TFOS_WIRE_FORMAT=pickle (the A/B
+        # knob) or a corruption fault targets this feeder — byte corruption
+        # is specified over one serialized stream, i.e. the pickled path.
+        self._framed = (wire.enabled() and not (
+            self._injector.enabled
+            and self._injector.spec.get("corrupt_chunk_index") is not None))
         # Attach-only: the node process created the ring at startup (run());
         # a feed task must never create one, or a recycled Spark worker's
         # exit would unlink it under the live consumer (see run()).  No ring
@@ -613,9 +623,11 @@ class _ChunkPutter(object):
             chunk = marker.Chunk(block)
         data = self._send(chunk, n, data=None)
         if self._cache is not None:
-            # When the ring path was taken, the bytes alone suffice for
-            # replay (holding the chunk too would double the partition's
-            # resident footprint for the whole feed).
+            # When the pickled ring path was taken, the bytes alone suffice
+            # for replay (holding the chunk too would double the partition's
+            # resident footprint for the whole feed).  Framed chunks cache
+            # as the chunk object — its columns are the raw buffers the
+            # replay gather-writes again, so there is nothing cheaper.
             self._cache.append((None if data is not None else chunk, n, data))
 
     def reput_cached(self):
@@ -645,17 +657,30 @@ class _ChunkPutter(object):
         return False
 
     def _send(self, chunk, n, data):
-        """Ship one chunk; returns the serialized bytes if the ring path was
-        taken (for the epoch-repeat cache), else None."""
+        """Ship one chunk; returns the pickled bytes if the pickled ring
+        path was taken (for the epoch-repeat cache), else None (framed and
+        in-queue sends cache the chunk object itself)."""
         import pickle
 
+        from tensorflowonspark_tpu import wire
+
         if self._ring is not None:
+            if (self._framed and data is None
+                    and isinstance(chunk, marker.ColChunk)):
+                parts = wire.encode_chunk(chunk)
+                if parts is not None and self._ring.put_vectored(
+                        parts, timeout_secs=self._feed_timeout):
+                    self._queue.put(
+                        marker.ShmChunk(self._ring.name, n,
+                                        fmt=wire.WIRE_COLV1), block=True)
+                    return None
+                # non-framable columns or an oversized record: pickled path
             if data is None:
                 data = pickle.dumps(chunk, protocol=pickle.HIGHEST_PROTOCOL)
             # Ship possibly-corrupted bytes but cache the CLEAN ones: the
             # injected fault models one bad transfer, not a poisoned cache.
-            wire = self._injector.corrupt(data)
-            if self._ring.put_bytes(wire, timeout_secs=self._feed_timeout):
+            payload = self._injector.corrupt(data)
+            if self._ring.put_bytes(payload, timeout_secs=self._feed_timeout):
                 self._queue.put(marker.ShmChunk(self._ring.name, n),
                                 block=True)
                 return data

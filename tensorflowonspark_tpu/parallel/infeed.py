@@ -6,8 +6,9 @@ into a ``tf.data.from_generator`` (reference ``TFNode.py:105-151`` +
 ``examples/mnist/keras/mnist_spark.py:31-47``) — a per-element IPC hop that
 caps accelerator utilization.  Here each host:
 
-1. drains its queue into **columnar numpy batches** (one proxy round-trip per
-   item is unavoidable, but assembly is columnar and amortized),
+1. drains its queue into **columnar numpy batches** (feeders ship ColChunks
+   as zero-copy framed ring records — :mod:`~tensorflowonspark_tpu.wire` —
+   so assembly is columnar, amortized, and unpickle-free on the fast path),
 2. forms its *local shard* of the global batch and transfers it in a single
    ``jax.make_array_from_process_local_data`` call,
 3. runs a tiny cross-host consensus each step so all hosts agree whether a
@@ -286,6 +287,13 @@ class ShardedFeed(object):
         _, stack, masks = item
         slice_fn = _group_slicer()
         return [("single",) + slice_fn((stack, masks), i) for i in range(k)]
+
+    def wire_formats(self):
+        """Transport/format counts the underlying feed observed, e.g.
+        ``{"colv1": 120}`` when the zero-copy framed ring path carried every
+        chunk (see :attr:`~tensorflowonspark_tpu.datafeed.DataFeed.wire_formats`);
+        the bench feedplane leg records this next to its throughput."""
+        return dict(getattr(self.feed, "wire_formats", None) or {})
 
     def terminate(self):
         """Terminate feeding early (training hit max steps with data left):

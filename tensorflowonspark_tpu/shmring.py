@@ -30,7 +30,9 @@ _TIMEOUT = -1
 
 
 def _lib():
-    lib = native.load("shmring")
+    # librt: shm_open lives there until glibc 2.34 folded it into libc;
+    # on newer glibc librt is an empty stub, so linking it is always safe.
+    lib = native.load("shmring", libs=("rt",))
     if lib is None:
         return None
     if not getattr(lib, "_shmring_typed", False):
@@ -41,8 +43,17 @@ def _lib():
         lib.shmring_write.restype = ctypes.c_int
         lib.shmring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                       ctypes.c_uint64, ctypes.c_uint64]
+        lib.shmring_writev.restype = ctypes.c_int
+        lib.shmring_writev.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_void_p),
+                                       ctypes.POINTER(ctypes.c_uint64),
+                                       ctypes.c_uint64, ctypes.c_uint64]
         lib.shmring_next_len.restype = ctypes.c_int64
         lib.shmring_next_len.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.shmring_peek.restype = ctypes.c_int64
+        lib.shmring_peek.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                     ctypes.POINTER(ctypes.c_void_p)]
+        lib.shmring_consume.argtypes = [ctypes.c_void_p]
         lib.shmring_pop.restype = ctypes.c_int64
         lib.shmring_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                     ctypes.c_uint64]
@@ -123,6 +134,39 @@ class Ring(object):
             "shm ring {} write timed out after {}s (consumer stalled?)".format(
                 self.name, timeout_secs))
 
+    def put_vectored(self, parts, timeout_secs=600):
+        """Gather-write ONE record from several buffers (bytes, or objects
+        with the ndarray ``.ctypes``/``.nbytes`` surface) — one memcpy per
+        buffer straight into the ring, no intermediate join/serialization
+        buffer (the zero-copy columnar frame path, see
+        :mod:`~tensorflowonspark_tpu.wire`).  Same return/raise contract as
+        :meth:`put_bytes`."""
+        n = len(parts)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        keep = []  # pin bytes objects for the duration of the call
+        for i, p in enumerate(parts):
+            if hasattr(p, "ctypes"):  # ndarray (duck-typed: no numpy dep here)
+                ptrs[i] = p.ctypes.data
+                lens[i] = p.nbytes
+            else:
+                b = p if isinstance(p, bytes) else bytes(p)
+                keep.append(b)
+                ptrs[i] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
+                lens[i] = len(b)
+        rc = _lib().shmring_writev(self._h, ptrs, lens, n,
+                                   int(timeout_secs * 1000))
+        del keep
+        if rc == 0:
+            return True
+        if rc == -3:
+            return False
+        if rc == _CLOSED:
+            raise RingClosed(self.name)
+        raise TimeoutError(
+            "shm ring {} write timed out after {}s (consumer stalled?)".format(
+                self.name, timeout_secs))
+
     def get_bytes(self, timeout_secs=600):
         """Read one record; raises RingClosed at end, TimeoutError on stall."""
         lib = _lib()
@@ -135,8 +179,38 @@ class Ring(object):
                     self.name, timeout_secs))
         buf = ctypes.create_string_buffer(int(n))
         got = lib.shmring_pop(self._h, buf, int(n))
-        assert got == n, (got, n)
+        if got != n:
+            # A short read means the ring is desynced — silently returning
+            # truncated bytes would corrupt training data, and an assert
+            # vanishes under python -O (the repo's rule for data-integrity
+            # checks; see datafeed._ring_read's desync check).
+            raise RuntimeError(
+                "shm ring {} short read: next_len promised {} bytes, pop "
+                "returned {}".format(self.name, n, got))
         return buf.raw
+
+    def peek(self, timeout_secs=600):
+        """Two-phase zero-copy read, phase 1: a memoryview over the next
+        record's bytes IN ring memory (no copy).  The view is valid only
+        until :meth:`consume` releases the record back to the producer —
+        copy whatever outlives the record before consuming.  Raises
+        RingClosed at end, TimeoutError on stall (like :meth:`get_bytes`)."""
+        lib = _lib()
+        ptr = ctypes.c_void_p()
+        n = lib.shmring_peek(self._h, int(timeout_secs * 1000),
+                             ctypes.byref(ptr))
+        if n == _CLOSED:
+            raise RingClosed(self.name)
+        if n == _TIMEOUT:
+            raise TimeoutError(
+                "shm ring {} read timed out after {}s".format(
+                    self.name, timeout_secs))
+        return memoryview((ctypes.c_ubyte * int(n)).from_address(ptr.value))
+
+    def consume(self):
+        """Two-phase zero-copy read, phase 2: release the record exposed by
+        the last :meth:`peek` (advances the tail; the peeked view is dead)."""
+        _lib().shmring_consume(self._h)
 
     def put(self, obj, timeout_secs=600):
         """Pickle + write; returns False when the object can never fit."""

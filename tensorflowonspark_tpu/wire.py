@@ -1,0 +1,196 @@
+"""Self-describing zero-copy columnar wire format for the shm-ring feed plane.
+
+The ring's original fast path pickled each
+:class:`~tensorflowonspark_tpu.marker.ColChunk` — PERF.md's stage profile
+shows that pack+pickle (3.57 ms) plus unpickle (1.88 ms) per 1024-row batch
+dwarf the raw ring round-trip (1.57 ms), and every payload byte was copied
+twice more through intermediate pickle buffers.  This module replaces the
+pickle bytes with a **frame**: a small self-describing header followed by
+each column's raw buffer, so the producer gather-writes the columns
+straight into the ring (``Ring.put_vectored`` — one memcpy per column) and
+the consumer wraps the in-ring record with ``np.frombuffer`` views and
+copies each column exactly once (``decode(copy=True)``), directly into
+batch assembly.  tf.data (arXiv:2101.12127) and the tf.data service
+(arXiv:2210.14826) both identify exactly this host-side input
+serialization as the scaling wall once transport is fast.
+
+Frame layout (all little-endian, no alignment padding)::
+
+    fixed header (32 bytes):
+      0:4    magic  b"TFWC"
+      4:6    u16    version (1)
+      6:8    u16    flags   (bit 0: tuple_rows)
+      8:12   u32    ncols
+      12:20  u64    count        (rows promised — the token desync check)
+      20:28  u64    frame_len    (total frame bytes, header included)
+      28:32  u32    header_len   (data section offset = end of descriptors)
+    per-column descriptor (32 + 8*ndim bytes):
+      8s     dtype.str, NUL-padded (e.g. b"<f4")
+      u32    ndim
+      u32    reserved (0)
+      u64    offset   (column data start, from frame start)
+      u64    nbytes
+      u64*n  shape
+
+Only plain numeric/bool/complex dtypes (``dtype.kind in "biufc"``) on
+C-contiguous arrays are framable; anything else (object columns, unicode,
+non-contiguous views, ragged data) returns ``None`` from :func:`encode`
+and the caller falls back to the pickled transport — the same soft-fallback
+contract :func:`~tensorflowonspark_tpu.columnar.rows_to_fields` uses.
+"""
+
+import math
+import os
+import struct
+
+import numpy as np
+
+__all__ = [
+    "FrameError", "WIRE_PICKLE", "WIRE_COLV1", "enabled",
+    "encode", "encode_chunk", "frame_bytes", "decode", "decode_chunk",
+]
+
+MAGIC = b"TFWC"
+VERSION = 1
+
+# Wire-format tags carried by marker.ShmChunk tokens (and reported by
+# DataFeed.wire_formats / the bench feedplane leg):
+WIRE_PICKLE = "pickle"   # pickled Chunk/ColChunk object bytes (legacy path)
+WIRE_COLV1 = "colv1"     # this module's columnar frame, version 1
+
+_FIXED = struct.Struct("<4sHHIQQI")     # magic ver flags ncols count flen hlen
+_DESC = struct.Struct("<8sIIQQ")        # dtype ndim reserved offset nbytes
+
+_FRAMABLE_KINDS = "biufc"   # bool, (u)int, float, complex — raw-copy safe
+
+
+class FrameError(ValueError):
+    """A buffer is not a valid columnar frame (truncated, corrupt, or an
+    unsupported version) — the consumer must not trust any of its fields."""
+
+
+def enabled():
+    """Whether the framed path may be used (``TFOS_WIRE_FORMAT=pickle``
+    forces the pickled transport — the A/B knob for profiling and parity
+    testing)."""
+    return os.environ.get("TFOS_WIRE_FORMAT", "").lower() != WIRE_PICKLE
+
+
+def encode(columns, count, tuple_rows):
+    """Frame ``columns`` for a gather write.
+
+    Returns ``[header_bytes, col0, col1, ...]`` — the header plus the column
+    ndarrays themselves, ready for ``Ring.put_vectored`` (no column bytes
+    are copied here) — or ``None`` when the columns aren't framable
+    (non-ndarray, non-numeric dtype, or non-contiguous: callers fall back
+    to pickle).
+    """
+    descs = []
+    header_len = _FIXED.size + sum(
+        _DESC.size + 8 * getattr(c, "ndim", 0) for c in columns)
+    offset = header_len
+    for col in columns:
+        if not isinstance(col, np.ndarray):
+            return None
+        if col.dtype.kind not in _FRAMABLE_KINDS:
+            return None
+        if not col.flags.c_contiguous:
+            return None
+        dstr = col.dtype.str.encode("ascii")
+        if len(dstr) > 8:
+            return None
+        descs.append(_DESC.pack(dstr, col.ndim, 0, offset, col.nbytes)
+                     + struct.pack("<%dQ" % col.ndim, *col.shape))
+        offset += col.nbytes
+    header = _FIXED.pack(MAGIC, VERSION, 1 if tuple_rows else 0,
+                         len(columns), count, offset, header_len)
+    return [header + b"".join(descs)] + list(columns)
+
+
+def encode_chunk(chunk):
+    """Frame a :class:`~tensorflowonspark_tpu.marker.ColChunk` (or ``None``
+    when it isn't framable)."""
+    return encode(chunk.columns, chunk.count, chunk.tuple_rows)
+
+
+def frame_bytes(columns, count, tuple_rows):
+    """One contiguous frame as bytes (tests / non-vectored transports); the
+    ring path uses :func:`encode`'s gather parts instead to skip this join.
+    ``None`` when not framable."""
+    parts = encode(columns, count, tuple_rows)
+    if parts is None:
+        return None
+    return b"".join(p.tobytes() if isinstance(p, np.ndarray) else p
+                    for p in parts)
+
+
+def decode(buf, copy=True):
+    """Parse one frame; returns ``(columns, count, tuple_rows)``.
+
+    ``copy=True`` (the ring path's contract): each column is copied exactly
+    once out of ``buf`` — required when ``buf`` is in-ring memory that the
+    producer reclaims after ``Ring.consume``.  ``copy=False`` returns
+    zero-copy ``np.frombuffer`` views into ``buf`` (only safe while the
+    caller keeps ``buf`` alive and unrecycled).
+
+    Raises :class:`FrameError` on anything malformed: wrong magic/version,
+    truncation, descriptor/shape inconsistencies, out-of-bounds column
+    extents.
+    """
+    mv = memoryview(buf)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    total = len(mv)
+    if total < _FIXED.size:
+        raise FrameError("frame shorter than fixed header "
+                         "({} < {} bytes)".format(total, _FIXED.size))
+    magic, version, flags, ncols, count, frame_len, header_len = \
+        _FIXED.unpack_from(mv, 0)
+    if magic != MAGIC:
+        raise FrameError("bad frame magic {!r}".format(bytes(magic)))
+    if version != VERSION:
+        raise FrameError("unsupported frame version {}".format(version))
+    if frame_len != total:
+        raise FrameError("frame length mismatch: header says {} bytes, "
+                         "buffer has {}".format(frame_len, total))
+    if not _FIXED.size <= header_len <= total:
+        raise FrameError("header_len {} out of range".format(header_len))
+    columns = []
+    off = _FIXED.size
+    for c in range(ncols):
+        if off + _DESC.size > header_len:
+            raise FrameError("descriptor {} overruns header".format(c))
+        dstr, ndim, _reserved, offset, nbytes = _DESC.unpack_from(mv, off)
+        off += _DESC.size
+        if off + 8 * ndim > header_len:
+            raise FrameError("shape of column {} overruns header".format(c))
+        shape = struct.unpack_from("<%dQ" % ndim, mv, off)
+        off += 8 * ndim
+        try:
+            dtype = np.dtype(dstr.rstrip(b"\0").decode("ascii"))
+        except (TypeError, UnicodeDecodeError) as e:
+            raise FrameError("column {} has unparseable dtype: {}".format(c, e))
+        if dtype.kind not in _FRAMABLE_KINDS:
+            raise FrameError("column {} has non-framable dtype {}".format(
+                c, dtype))
+        n_elem = math.prod(shape)
+        if nbytes != n_elem * dtype.itemsize:
+            raise FrameError(
+                "column {} nbytes {} != shape {} x itemsize {}".format(
+                    c, nbytes, shape, dtype.itemsize))
+        if offset < header_len or offset + nbytes > total:
+            raise FrameError("column {} extent [{}, {}) outside frame of "
+                             "{} bytes".format(c, offset, offset + nbytes,
+                                               total))
+        arr = np.frombuffer(mv, dtype=dtype, count=n_elem,
+                            offset=offset).reshape(shape)
+        columns.append(arr.copy() if copy else arr)
+    return tuple(columns), count, bool(flags & 1)
+
+
+def decode_chunk(buf, copy=True):
+    """Parse one frame into a :class:`~tensorflowonspark_tpu.marker.ColChunk`."""
+    from tensorflowonspark_tpu import marker
+
+    columns, count, tuple_rows = decode(buf, copy=copy)
+    return marker.ColChunk(columns, count, tuple_rows)

@@ -5,7 +5,7 @@ import os
 
 import pytest
 
-from tensorflowonspark_tpu import backend, cluster
+from tensorflowonspark_tpu import backend, cluster, shmring
 from tensorflowonspark_tpu.cluster import InputMode
 
 
@@ -547,3 +547,136 @@ def test_is_tpu_device_keys_on_silicon_not_backend_name():
     import jax
     if jax.default_backend() == "cpu":
         assert not device_info.is_tpu_device()
+
+
+def _collect_feed_run(map_fun, rows, env, collect, chunk_size=6):
+    """Spin one 2-executor SPARK-mode cluster under ``env``, train one epoch
+    of ``rows`` through it, and return ``[collect(executor_dir), ...]`` plus
+    the aggregated transport tally.  Artifacts must be read via ``collect``
+    inside this call: ``b.stop()`` removes the executor workdirs."""
+    import json
+    import time
+
+    b = backend.LocalBackend(2, env=env) if env else backend.LocalBackend(2)
+    try:
+        c = cluster.run(b, map_fun, tf_args=[], num_executors=2,
+                        input_mode=InputMode.SPARK)
+        c.train(backend.partition(rows, 4), num_epochs=1,
+                chunk_size=chunk_size)
+        c.shutdown()
+        outs, fmts = [], {}
+        for i in range(2):
+            d = os.path.join(b.workdir_root, "executor-{}".format(i))
+            # shutdown poisons the queues but does not wait for the training
+            # process to return from map_fun: poll for its artifacts.
+            # map_fun writes wire.json LAST, so once it parses, everything
+            # it wrote before is complete.
+            deadline = time.time() + 30
+            while True:
+                try:
+                    with open(os.path.join(d, "wire.json")) as f:
+                        per = json.load(f)
+                    break
+                except (OSError, ValueError):
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.1)
+            outs.append(collect(d))
+            for k, v in per.items():
+                fmts[k] = fmts.get(k, 0) + v
+        return outs, fmts
+    finally:
+        b.stop()
+
+
+def test_wire_parity_framed_vs_disabled_shm():
+    """Acceptance: the zero-copy framed ring path and the ring-less
+    TFOS_DISABLE_SHM path must deliver element-identical rows end to end —
+    the wire format is a transport, never a transform."""
+    import json
+
+    import numpy as np
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed()
+        xs, ys = [], []
+        while not feed.should_stop():
+            arrays, count = feed.next_batch_arrays(6)
+            if count:
+                xs.append(arrays[0])
+                ys.append(arrays[1])
+        np.savez("rows.npz",
+                 x=np.concatenate(xs) if xs else np.empty((0, 4), np.float32),
+                 y=np.concatenate(ys) if ys else np.empty((0,), np.int64))
+        with open("wire.json", "w") as f:
+            json.dump(getattr(feed, "wire_formats", {}), f)
+
+    rows = [(np.full(4, 3 * i + 1, np.float32), i) for i in range(24)]
+
+    def collect(d):
+        data = np.load(os.path.join(d, "rows.npz"))
+        return data["x"], data["y"]
+
+    def run(env):
+        outs, fmts = _collect_feed_run(map_fun, rows, env, collect)
+        x = np.concatenate([o[0] for o in outs])
+        y = np.concatenate([o[1] for o in outs])
+        order = np.argsort(y, kind="stable")  # labels are unique: a total
+        return x[order], y[order], fmts       # order independent of which
+                                              # executor got which partition
+
+    x_framed, y_framed, fmt_framed = run(None)
+    x_plain, y_plain, fmt_plain = run({"TFOS_DISABLE_SHM": "1"})
+
+    np.testing.assert_array_equal(x_framed, x_plain)
+    np.testing.assert_array_equal(y_framed, y_plain)
+    assert y_framed.tolist() == list(range(24))
+    # the disabled run must never have touched a ring
+    assert set(fmt_plain) <= {"queue"}, fmt_plain
+    if shmring.available():
+        # uniform numeric rows on a ring host: every chunk took the frame
+        assert fmt_framed.get("colv1"), fmt_framed
+        assert "pickle" not in fmt_framed, fmt_framed
+
+
+def test_wire_parity_object_chunks_on_ring():
+    """Ragged rows can't be framed (rows_to_fields soft-fails), so on a
+    ring host they travel as pickled object chunks on the SAME ring the
+    framed records use — and must still match the ring-less run exactly."""
+    import json
+
+    def map_fun(args, ctx):
+        feed = ctx.get_data_feed()
+        items = []
+        while not feed.should_stop():
+            got = feed.next_batch(5)
+            items.extend(got)
+        # normalize: a single-row remainder chunk is trivially uniform, so
+        # it may round-trip as an ndarray row (columnar path quirk shared
+        # by every transport) — parity is about VALUES
+        with open("items.json", "w") as f:
+            json.dump(sorted([int(v) for v in it] for it in items), f)
+        with open("wire.json", "w") as f:
+            json.dump(getattr(feed, "wire_formats", {}), f)
+
+    # variable-length rows: pack_columnar returns None -> object Chunk
+    rows = [[i] * (1 + i % 3) for i in range(18)]
+
+    def collect(d):
+        with open(os.path.join(d, "items.json")) as f:
+            return json.load(f)
+
+    def run(env):
+        outs, fmts = _collect_feed_run(map_fun, rows, env, collect,
+                                       chunk_size=4)
+        return sorted(sum(outs, [])), fmts
+
+    items_framed, fmt_framed = run(None)
+    items_plain, fmt_plain = run({"TFOS_DISABLE_SHM": "1"})
+
+    assert items_framed == items_plain == sorted(rows)
+    assert set(fmt_plain) <= {"queue"}, fmt_plain
+    if shmring.available():
+        # object chunks on a ring host take the pickled ring path (the
+        # single-row remainder chunks may legitimately frame as colv1)
+        assert fmt_framed.get("pickle"), fmt_framed

@@ -1,89 +1,336 @@
-"""Property-based round-trip tests for the wire formats the framework
-hand-implements (native/py TFRecord framing, tf.train.Example protos,
-columnar chunk packing) — randomized inputs catch the framing edge cases
-fixed-fixture tests miss."""
+"""Round-trip tests for the wire formats the framework hand-implements
+(native/py TFRecord framing, tf.train.Example protos, columnar chunk
+packing, and the shm-ring columnar frame).
+
+Two tiers: deterministic tests of :mod:`tensorflowonspark_tpu.wire` (always
+run — the framed ring path is a data-integrity surface), plus
+property-based tests (randomized inputs catch the framing edge cases
+fixed-fixture tests miss) that skip where hypothesis is absent.
+"""
+
+import os
+import pickle
+import subprocess
+import sys
+import uuid
 
 import numpy as np
 import pytest
 
-pytest.importorskip("hypothesis")  # property tests skip where absent
-from hypothesis import given, settings, strategies as st  # noqa: E402
+from tensorflowonspark_tpu import marker, shmring, wire
 
-from tensorflowonspark_tpu import example_proto, marker, tfrecord
-
-
-@st.composite
-def feature_dicts(draw):
-    names = draw(st.lists(
-        st.text(st.characters(min_codepoint=97, max_codepoint=122),
-                min_size=1, max_size=12),
-        min_size=1, max_size=5, unique=True))
-    out = {}
-    for name in names:
-        kind = draw(st.sampled_from(["bytes", "float", "int64"]))
-        if kind == "bytes":
-            vals = draw(st.lists(st.binary(max_size=64), min_size=1,
-                                 max_size=4))
-        elif kind == "float":
-            vals = draw(st.lists(
-                st.floats(allow_nan=False, allow_infinity=False,
-                          width=32), min_size=1, max_size=8))
-        else:
-            vals = draw(st.lists(
-                st.integers(min_value=-(2 ** 63), max_value=2 ** 63 - 1),
-                min_size=1, max_size=8))
-        out[name] = (kind, vals)
-    return out
+try:
+    from hypothesis import given, settings, strategies as st
+    HAVE_HYPOTHESIS = True
+except ImportError:  # property tests skip where absent
+    HAVE_HYPOTHESIS = False
 
 
-@settings(max_examples=50, deadline=None)
-@given(feature_dicts())
-def test_example_proto_roundtrip(features):
-    enc = example_proto.encode_example(features)
-    dec = example_proto.decode_example(enc)
-    assert set(dec) == set(features)
-    for name, (kind, vals) in features.items():
-        dkind, dvals = dec[name]
-        assert dkind == kind
-        if kind == "float":
-            np.testing.assert_allclose(dvals, np.asarray(vals, np.float32),
-                                       rtol=1e-6)
-        elif kind == "bytes":
-            assert [bytes(v) for v in dvals] == [bytes(v) for v in vals]
-        else:
-            assert list(dvals) == vals
+# ---------------------------------------------------------------------------
+# columnar frame (wire.py): deterministic coverage
+# ---------------------------------------------------------------------------
+
+NUMERIC_DTYPES = [
+    np.bool_, np.int8, np.int16, np.int32, np.int64,
+    np.uint8, np.uint16, np.uint32, np.uint64,
+    np.float16, np.float32, np.float64,
+    np.complex64, np.complex128,
+]
 
 
-@settings(max_examples=25, deadline=None)
-@given(records=st.lists(st.binary(max_size=2048), min_size=0, max_size=20),
-       use_native=st.booleans())
-def test_tfrecord_framing_roundtrip(tmp_path_factory, records, use_native):
-    path = str(tmp_path_factory.mktemp("tfr") / "f.tfrecord")
-    with tfrecord.TFRecordWriter(path, use_native=use_native) as w:
-        for r in records:
-            w.write(r)
-    got = [bytes(r) for r in tfrecord.tfrecord_iterator(
-        path, use_native=use_native)]
-    assert got == records
-    # cross-engine: records written by one engine read by the other
-    got2 = [bytes(r) for r in tfrecord.tfrecord_iterator(
-        path, use_native=not use_native)]
-    assert got2 == records
+def _roundtrip(columns, count, tuple_rows, copy=True):
+    buf = wire.frame_bytes(columns, count, tuple_rows)
+    assert buf is not None
+    return wire.decode(buf, copy=copy)
 
 
-@settings(max_examples=30, deadline=None)
-@given(st.integers(min_value=1, max_value=64),
-       st.integers(min_value=1, max_value=5),
-       st.sampled_from(["f4", "i8", "u1"]))
-def test_colchunk_pack_row_roundtrip(n_rows, arity, dtype):
-    rng = np.random.RandomState(n_rows * 7 + arity)
-    cols = tuple(rng.randint(0, 100, size=(n_rows, 3)).astype(dtype)
-                 for _ in range(arity))
-    rows = [tuple(col[i] for col in cols) for i in range(n_rows)]
-    chunk = marker.pack_columnar(rows)
-    if isinstance(chunk, marker.ColChunk):
-        assert chunk.count == n_rows
-        for i in range(n_rows):
-            row = chunk.row(i)
-            for f in range(arity):
-                np.testing.assert_array_equal(np.asarray(row[f]), cols[f][i])
+@pytest.mark.parametrize("dtype", NUMERIC_DTYPES,
+                         ids=[np.dtype(d).name for d in NUMERIC_DTYPES])
+def test_frame_roundtrip_numeric_dtypes(dtype):
+    rng = np.random.default_rng(0)
+    a = (rng.random((5, 3)) * 100).astype(dtype)
+    b = (rng.random((5,)) * 100).astype(dtype)
+    cols, count, tuple_rows = _roundtrip((a, b), 5, True)
+    assert count == 5 and tuple_rows
+    assert cols[0].dtype == a.dtype and cols[1].dtype == b.dtype
+    np.testing.assert_array_equal(cols[0], a)
+    np.testing.assert_array_equal(cols[1], b)
+
+
+def test_frame_roundtrip_bf16_as_uint16():
+    # bfloat16 travels as its uint16 bit-pattern carrier: the custom dtype
+    # itself isn't in the framable kinds, but its view round-trips
+    # bit-exactly and the consumer can reinterpret.
+    bits = np.array([0x3F80, 0x4000, 0xC0A0, 0x0000, 0x7F80],
+                    np.uint16).reshape(5, 1)
+    cols, count, _ = _roundtrip((bits,), 5, False)
+    np.testing.assert_array_equal(cols[0], bits)
+    assert cols[0].dtype == np.uint16
+    try:
+        import ml_dtypes
+    except ImportError:
+        return
+    bf = bits.view(ml_dtypes.bfloat16)
+    if np.dtype(ml_dtypes.bfloat16).kind not in wire._FRAMABLE_KINDS:
+        # the raw custom dtype must soft-fall-back, never mis-frame
+        assert wire.encode((bf,), 5, False) is None
+
+
+def test_frame_roundtrip_zero_dim_and_empty_columns():
+    scalar = np.array(3.5, np.float32)         # 0-d: ndim 0, 1 element
+    empty = np.empty((0, 7), np.int64)         # 0 rows, nbytes 0
+    cols, count, tuple_rows = _roundtrip((scalar, empty), 0, True)
+    assert cols[0].shape == () and cols[0] == np.float32(3.5)
+    assert cols[1].shape == (0, 7) and cols[1].dtype == np.int64
+
+
+def test_encode_rejects_non_contiguous_and_object_columns():
+    base = np.arange(24, dtype=np.float32).reshape(4, 6)
+    assert wire.encode((base[:, ::2],), 4, False) is None       # strided
+    assert wire.encode((base.T,), 6, False) is None             # transposed
+    assert wire.encode((np.array([b"x", b"yy"], object),), 2, False) is None
+    assert wire.encode((np.array(["a", "b"]),), 2, False) is None  # unicode
+    assert wire.encode(([1, 2, 3],), 3, False) is None          # non-ndarray
+    # and the soft-fallback composes with put-side framing: a contiguous
+    # copy of the same data IS framable
+    assert wire.encode((np.ascontiguousarray(base[:, ::2]),), 4,
+                       False) is not None
+
+
+def test_decode_rejects_truncated_and_corrupt_frames():
+    good = wire.frame_bytes((np.arange(6, dtype=np.int32).reshape(2, 3),),
+                            2, False)
+    # truncated: below fixed-header size, and mid-frame
+    with pytest.raises(wire.FrameError):
+        wire.decode(good[:10])
+    with pytest.raises(wire.FrameError):
+        wire.decode(good[:-4])
+    # bad magic
+    bad = bytearray(good)
+    bad[:4] = b"XXXX"
+    with pytest.raises(wire.FrameError):
+        wire.decode(bytes(bad))
+    # unsupported version
+    bad = bytearray(good)
+    bad[4] = 99
+    with pytest.raises(wire.FrameError):
+        wire.decode(bytes(bad))
+    # corrupt descriptor: nbytes no longer matches shape x itemsize
+    bad = bytearray(good)
+    import struct as _struct
+    desc_off = wire._FIXED.size
+    dstr, ndim, res, off, nbytes = wire._DESC.unpack_from(bad, desc_off)
+    wire._DESC.pack_into(bad, desc_off, dstr, ndim, res, off, nbytes + 4)
+    with pytest.raises(wire.FrameError):
+        wire.decode(bytes(bad))
+    # column extent pointing outside the frame
+    bad = bytearray(good)
+    wire._DESC.pack_into(bad, desc_off, dstr, ndim, res, len(good), nbytes)
+    with pytest.raises(wire.FrameError):
+        wire.decode(bytes(bad))
+    del _struct
+    # the pristine frame still decodes (the mutations above were on copies)
+    cols, count, _ = wire.decode(good)
+    assert count == 2
+    np.testing.assert_array_equal(cols[0],
+                                  np.arange(6, dtype=np.int32).reshape(2, 3))
+
+
+def test_decode_copy_false_returns_views_copy_true_owns():
+    col = np.arange(12, dtype=np.float64).reshape(3, 4)
+    buf = wire.frame_bytes((col,), 3, False)
+    views, _, _ = wire.decode(buf, copy=False)
+    backing = np.frombuffer(buf, np.uint8)
+    assert np.shares_memory(views[0], backing)
+    owned, _, _ = wire.decode(buf, copy=True)
+    assert not np.shares_memory(owned[0], backing)
+    np.testing.assert_array_equal(owned[0], col)
+
+
+def test_encode_chunk_decode_chunk_symmetry():
+    chunk = marker.ColChunk(
+        (np.arange(8, dtype=np.float32).reshape(4, 2),
+         np.array([0, 1, 2, 3], np.int64)), 4, True)
+    parts = wire.encode_chunk(chunk)
+    assert parts is not None and len(parts) == 3
+    buf = b"".join(p.tobytes() if isinstance(p, np.ndarray) else p
+                   for p in parts)
+    out = wire.decode_chunk(buf)
+    assert isinstance(out, marker.ColChunk)
+    assert out.count == 4 and out.tuple_rows
+    assert out.row(2) == (pytest.approx(np.array([4.0, 5.0], np.float32)), 2)
+
+
+# ---------------------------------------------------------------------------
+# framed records through the real ring (skip where the native lib is absent)
+# ---------------------------------------------------------------------------
+
+ring_required = pytest.mark.skipif(not shmring.available(),
+                                   reason="native shm ring unavailable")
+
+
+@ring_required
+def test_ring_writev_peek_roundtrip_interleaved_with_pickle():
+    name = "/tfos_test_wire_{}".format(uuid.uuid4().hex[:8])
+    ring = shmring.Ring.create_or_attach(name, 1 << 20)
+    assert ring is not None
+    try:
+        chunk = marker.ColChunk(
+            (np.arange(12, dtype=np.float32).reshape(3, 4),
+             np.array([7, 8, 9], np.int64)), 3, True)
+        assert ring.put_vectored(wire.encode_chunk(chunk), timeout_secs=5)
+        blob = pickle.dumps({"k": 1})
+        assert ring.put_bytes(blob, timeout_secs=5)
+        # framed record via two-phase peek/consume
+        view = ring.peek(timeout_secs=5)
+        out = wire.decode_chunk(view, copy=True)
+        ring.consume()
+        np.testing.assert_array_equal(out.columns[0], chunk.columns[0])
+        np.testing.assert_array_equal(out.columns[1], chunk.columns[1])
+        # pickled record after it, untouched by the framed read
+        assert pickle.loads(ring.get_bytes(timeout_secs=5)) == {"k": 1}
+        # zero-copy decode reads the ring memory in place
+        assert ring.put_vectored(wire.encode_chunk(chunk), timeout_secs=5)
+        zc = wire.decode_chunk(ring.peek(timeout_secs=5), copy=False)
+        np.testing.assert_array_equal(zc.columns[0], chunk.columns[0])
+        ring.consume()
+    finally:
+        ring.detach(unlink=True)
+
+
+@ring_required
+def test_short_read_raises_runtime_error(monkeypatch):
+    # the desync check must be a RuntimeError (not an assert): it guards
+    # training-data integrity, so it must survive python -O
+    name = "/tfos_test_short_{}".format(uuid.uuid4().hex[:8])
+    ring = shmring.Ring.create_or_attach(name, 1 << 16)
+    assert ring is not None
+    try:
+        assert ring.put_bytes(b"x" * 100, timeout_secs=5)
+        lib = shmring._lib()
+        monkeypatch.setattr(lib, "shmring_pop",
+                            lambda h, buf, n: int(n) - 1)
+        with pytest.raises(RuntimeError, match="short read"):
+            ring.get_bytes(timeout_secs=5)
+    finally:
+        monkeypatch.undo()
+        ring.detach(unlink=True)
+
+
+@ring_required
+@pytest.mark.slow
+def test_shmring_suite_passes_under_python_O():
+    # `python -O` strips asserts: the ring's integrity checks must not be
+    # implemented as asserts, so the whole shmring suite is re-run with
+    # optimizations on
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-O", "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         os.path.join(repo, "tests", "test_shmring.py")],
+        cwd=repo, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert " passed" in proc.stdout, proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# property-based tier (requires hypothesis)
+# ---------------------------------------------------------------------------
+
+if HAVE_HYPOTHESIS:
+    from tensorflowonspark_tpu import example_proto, tfrecord
+
+    @st.composite
+    def feature_dicts(draw):
+        names = draw(st.lists(
+            st.text(st.characters(min_codepoint=97, max_codepoint=122),
+                    min_size=1, max_size=12),
+            min_size=1, max_size=5, unique=True))
+        out = {}
+        for name in names:
+            kind = draw(st.sampled_from(["bytes", "float", "int64"]))
+            if kind == "bytes":
+                vals = draw(st.lists(st.binary(max_size=64), min_size=1,
+                                     max_size=4))
+            elif kind == "float":
+                vals = draw(st.lists(
+                    st.floats(allow_nan=False, allow_infinity=False,
+                              width=32), min_size=1, max_size=8))
+            else:
+                vals = draw(st.lists(
+                    st.integers(min_value=-(2 ** 63),
+                                max_value=2 ** 63 - 1),
+                    min_size=1, max_size=8))
+            out[name] = (kind, vals)
+        return out
+
+    @settings(max_examples=50, deadline=None)
+    @given(feature_dicts())
+    def test_example_proto_roundtrip(features):
+        enc = example_proto.encode_example(features)
+        dec = example_proto.decode_example(enc)
+        assert set(dec) == set(features)
+        for name, (kind, vals) in features.items():
+            dkind, dvals = dec[name]
+            assert dkind == kind
+            if kind == "float":
+                np.testing.assert_allclose(
+                    dvals, np.asarray(vals, np.float32), rtol=1e-6)
+            elif kind == "bytes":
+                assert [bytes(v) for v in dvals] == [bytes(v) for v in vals]
+            else:
+                assert list(dvals) == vals
+
+    @settings(max_examples=25, deadline=None)
+    @given(records=st.lists(st.binary(max_size=2048), min_size=0,
+                            max_size=20),
+           use_native=st.booleans())
+    def test_tfrecord_framing_roundtrip(tmp_path_factory, records,
+                                        use_native):
+        path = str(tmp_path_factory.mktemp("tfr") / "f.tfrecord")
+        with tfrecord.TFRecordWriter(path, use_native=use_native) as w:
+            for r in records:
+                w.write(r)
+        got = [bytes(r) for r in tfrecord.tfrecord_iterator(
+            path, use_native=use_native)]
+        assert got == records
+        # cross-engine: records written by one engine read by the other
+        got2 = [bytes(r) for r in tfrecord.tfrecord_iterator(
+            path, use_native=not use_native)]
+        assert got2 == records
+
+    @settings(max_examples=30, deadline=None)
+    @given(st.integers(min_value=1, max_value=64),
+           st.integers(min_value=1, max_value=5),
+           st.sampled_from(["f4", "i8", "u1"]))
+    def test_colchunk_pack_row_roundtrip(n_rows, arity, dtype):
+        rng = np.random.RandomState(n_rows * 7 + arity)
+        cols = tuple(rng.randint(0, 100, size=(n_rows, 3)).astype(dtype)
+                     for _ in range(arity))
+        rows = [tuple(col[i] for col in cols) for i in range(n_rows)]
+        chunk = marker.pack_columnar(rows)
+        if isinstance(chunk, marker.ColChunk):
+            assert chunk.count == n_rows
+            for i in range(n_rows):
+                row = chunk.row(i)
+                for f in range(arity):
+                    np.testing.assert_array_equal(np.asarray(row[f]),
+                                                  cols[f][i])
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.integers(min_value=0, max_value=32),
+           st.integers(min_value=1, max_value=4),
+           st.sampled_from(["?", "u1", "i2", "i4", "i8", "u8",
+                            "f2", "f4", "f8", "c8"]),
+           st.booleans())
+    def test_wire_frame_roundtrip_property(n_rows, arity, dtype, tuple_rows):
+        rng = np.random.RandomState(n_rows * 31 + arity)
+        cols = tuple(
+            rng.randint(0, 2 if dtype == "?" else 100,
+                        size=(n_rows, f + 1)).astype(dtype)
+            for f in range(arity))
+        got, count, tr = _roundtrip(cols, n_rows, tuple_rows)
+        assert count == n_rows and tr == tuple_rows
+        for a, b in zip(got, cols):
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)

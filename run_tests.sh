@@ -20,10 +20,15 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
-python -m pytest tests/ -q --durations=10 "$@"
-rc=$?
+# capture the exit code without tripping `set -e` (a bare `rc=$?` after a
+# failing pytest would never run: -e aborts the script on the failure, and
+# the gates below must execute either way)
+rc=0
+python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 
-# the driver gates: compile-check the graft entry + the multi-chip dry run
+# the driver gates: compile-check the graft entry + the multi-chip dry run,
+# then prove the elastic-recovery loop closes on a real 3-node cluster
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+python scripts/ci_assert_elastic.py
 
 exit $rc

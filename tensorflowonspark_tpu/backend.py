@@ -198,36 +198,46 @@ class LocalBackend(object):
     #: driver's supervised feed retry can re-dispatch failed partitions.
     supports_task_retry = True
 
+    #: The driver's elastic recovery can ask this backend to spawn a FRESH
+    #: executor process into a dead node's freed roster slot
+    #: (:meth:`provision_replacement` + :meth:`run_on`).
+    supports_replacement = True
+
     def __init__(self, num_executors, env=None, env_per_executor=None, workdir_root=None):
         self.num_executors = num_executors
         self._owns_root = workdir_root is None
         self.workdir_root = workdir_root or tempfile.mkdtemp(prefix="tfos_tpu_local_")
         self._ctx = get_context("spawn")
+        self._base_env = dict(env or {})
         self._procs = []
         self._conns = []
         self._free = _queue.Queue()
         self._stopped = False
         self._excluded = set()  # executor indices fenced off from scheduling
+        self._lock = threading.Lock()  # guards _procs/_conns growth
         for i in range(num_executors):
             overrides = dict(env or {})
             if env_per_executor:
                 overrides.update(env_per_executor[i] or {})
-            parent_conn, child_conn = self._ctx.Pipe()
-            proc = self._ctx.Process(
-                target=_executor_main,
-                args=(
-                    i,
-                    os.path.join(self.workdir_root, "executor-{}".format(i)),
-                    child_conn,
-                    overrides,
-                ),
-                name="local-executor-{}".format(i),
-            )
-            proc.start()
-            child_conn.close()
-            self._procs.append(proc)
-            self._conns.append(parent_conn)
+            self._spawn_executor(i, overrides)
             self._free.put(i)
+
+    def _spawn_executor(self, i, overrides):
+        parent_conn, child_conn = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=_executor_main,
+            args=(
+                i,
+                os.path.join(self.workdir_root, "executor-{}".format(i)),
+                child_conn,
+                overrides,
+            ),
+            name="local-executor-{}".format(i),
+        )
+        proc.start()
+        child_conn.close()
+        self._procs.append(proc)
+        self._conns.append(parent_conn)
 
     # -- scheduling -------------------------------------------------------
 
@@ -272,6 +282,40 @@ class LocalBackend(object):
         if 0 <= executor_index < self.num_executors:
             self._excluded.add(executor_index)
             logger.warning("executor %d excluded from scheduling", executor_index)
+
+    def provision_replacement(self, env=None):
+        """Spawn a FRESH executor process for elastic recovery; returns its
+        executor index (a brand-new identity — never a recycled index, so
+        the liveness monitor's zombie fence on the dead executor keeps
+        holding).  The new executor gets its own working directory and does
+        NOT enter the free pool until its first task (the replacement start
+        task dispatched via :meth:`run_on`) completes."""
+        with self._lock:
+            i = len(self._procs)
+            overrides = dict(self._base_env)
+            overrides.update(env or {})
+            self._spawn_executor(i, overrides)
+            self.num_executors = len(self._procs)
+        logger.warning("provisioned replacement executor %d", i)
+        return i
+
+    def run_on(self, executor_index, fn, items):
+        """Dispatch one task DIRECTLY onto ``executor_index``, bypassing the
+        free pool (elastic recovery must land the replacement start task on
+        the replacement executor — any other executor's working dir already
+        hosts a node).  Returns a single-task :class:`JobHandle`; when the
+        task finishes, the executor joins the free pool for ordinary
+        scheduling (``_run_one``'s finally)."""
+        handle = JobHandle(1)
+        fn_bytes = cloudpickle.dumps(fn)
+        t = threading.Thread(
+            target=self._run_one,
+            args=(executor_index, 0, fn_bytes, list(items), handle),
+            name="task-on-{}".format(executor_index),
+            daemon=True,
+        )
+        t.start()
+        return handle
 
     def _live_executors(self):
         return [i for i, p in enumerate(self._procs)
@@ -370,11 +414,25 @@ class SparkBackend(object):
     (``TFSparkNode.py:110-115``).
 
     ``partitions`` arguments may be RDDs (used as-is) or lists (parallelized).
+
+    Elastic recovery on Spark is **Spark's own**: when an executor dies,
+    Spark re-runs its failed start/feed tasks on another executor
+    (``spark.task.maxFailures``), so a replacement node "re-lands" with the
+    task rather than via :meth:`LocalBackend.provision_replacement` — the
+    re-run start task registers from its fresh executor and claims the dead
+    node's released ``(job_name, task_index)`` slot exactly like a built-in
+    replacement would (the reservation server's admission path is backend
+    agnostic; only *who spawns the process* differs).  The driver therefore
+    does not request replacements here (``supports_replacement = False``).
     """
 
     #: Spark only reports job-level outcomes to the driver (task retries are
     #: Spark's own); the supervised feed retry therefore skips this backend.
     supports_task_retry = False
+
+    #: Replacement processes come from Spark's task retry (see class doc),
+    #: not from a driver-side provisioning call.
+    supports_replacement = False
 
     def __init__(self, sc, num_executors=None):
         import pyspark  # gated: only needed when this backend is chosen

@@ -72,6 +72,11 @@ class CheckpointManager(object):
             ),
         )
         self.save_interval_steps = save_interval_steps
+        # Resolved once: the corrupt_checkpoint fault fires ONCE per process,
+        # and a fresh from_env() per save would re-arm it every time.
+        from tensorflowonspark_tpu import fault
+
+        self._injector = fault.from_env()
 
     def maybe_save(self, step, state, force=False):
         """Save if an interval boundary was CROSSED since the last save;
@@ -99,6 +104,11 @@ class CheckpointManager(object):
             _globalize(state)), force=force)
         if saved:
             logger.info("checkpointed step %d to %s", step, self.directory)
+            if self._injector.enabled:
+                # chaos only: the injector garbles finalized step dirs, so
+                # flush the async save before handing it the directory
+                self._mgr.wait_until_finished()
+                self._injector.corrupt_checkpoint(self.directory)
         return saved
 
     def restore_latest(self, abstract_state):
@@ -119,6 +129,74 @@ class CheckpointManager(object):
             step, args=ocp.args.StandardRestore(abstract_state))
         logger.info("restored checkpoint step %d from %s", step, self.directory)
         return state, step
+
+    def restore_latest_valid(self, abstract_state):
+        """Like :meth:`restore_latest`, but VALIDATE before trusting: a
+        checkpoint can be partial (the writer was preempted mid-finalize) or
+        corrupt (bit rot, injected faults), and recovery crashing on it
+        defeats the point of retaining ``max_to_keep`` steps.
+
+        Per candidate (newest first): the step dir must exist under its
+        final (committed) name with content, and the restore itself must
+        succeed into ``abstract_state`` — the restore is the authoritative
+        structure/integrity check, there is no cheaper proxy orbax exposes.
+        An invalid step is QUARANTINED by renaming its dir to
+        ``<step>.corrupt`` (orbax no longer lists it; operators can inspect
+        it), then the previous retained step is tried.  Returns
+        ``(state, step)`` from the newest valid step, or ``(None, None)``
+        when no valid checkpoint remains (train from scratch)."""
+        import orbax.checkpoint as ocp
+
+        tried = set()
+        while True:
+            self._mgr.reload()
+            step = self._mgr.latest_step()
+            if step is None:
+                return None, None
+            if step in tried:
+                # quarantine did not remove it from the listing; give up
+                # rather than loop forever
+                logger.error("checkpoint step %d remains listed after "
+                             "quarantine; recovering from scratch", step)
+                return None, None
+            tried.add(step)
+            step_dir = os.path.join(self.directory, str(step))
+            try:
+                if not os.path.isdir(step_dir) or not os.listdir(step_dir):
+                    raise ValueError(
+                        "step dir {} missing or empty (uncommitted "
+                        "save)".format(step_dir))
+                state = self._mgr.restore(
+                    step, args=ocp.args.StandardRestore(abstract_state))
+            except Exception:
+                logger.warning(
+                    "checkpoint step %d failed validation; quarantining and "
+                    "falling back to the previous retained step", step,
+                    exc_info=True)
+                self._quarantine(step_dir)
+                continue
+            logger.info("restored validated checkpoint step %d from %s",
+                        step, self.directory)
+            return state, step
+
+    @staticmethod
+    def _quarantine(step_dir):
+        """Rename a bad step dir to ``<step>.corrupt`` (suffixed ``.N`` if
+        taken) so orbax stops listing it; tolerates a dir that is already
+        gone."""
+        if not os.path.isdir(step_dir):
+            return
+        target = step_dir + ".corrupt"
+        n = 0
+        while os.path.exists(target):
+            n += 1
+            target = "{}.corrupt.{}".format(step_dir, n)
+        try:
+            os.rename(step_dir, target)
+            logger.warning("quarantined bad checkpoint: %s -> %s",
+                           step_dir, target)
+        except OSError:
+            logger.exception("could not quarantine %s", step_dir)
 
     def latest_step(self, reload=True):
         """Newest saved step, or None.  ``reload=True`` re-reads the step

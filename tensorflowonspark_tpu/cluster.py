@@ -130,9 +130,20 @@ class TPUCluster(object):
                             "retries failed tasks itself")
             self._feed_or_latch(data, fn)
         else:
-            self._feed_or_latch(list(data), fn, retry_policy)
+            # Retries rebuild the closure from the CURRENT roster: after a
+            # replacement admission the dead node's cluster_info entry is
+            # gone and the replacement's (new executor id, new manager
+            # address) is in — a stale closure could not route a partition
+            # that lands on the replacement executor.
+            def _fn_factory():
+                return node.train(self.cluster_info, self.cluster_meta,
+                                  qname, feed_timeout, chunk_size,
+                                  max(num_epochs, 1))
 
-    def _feed_or_latch(self, partitions, fn, retry_policy=None):
+            self._feed_or_latch(list(data), fn, retry_policy, _fn_factory)
+
+    def _feed_or_latch(self, partitions, fn, retry_policy=None,
+                       fn_factory=None):
         """Dispatch a feed job; a failure (user-code error OR a consumer
         that died without one — e.g. OOM-killed, surfaced as the feeder's
         feed_timeout) is latched into ``tf_status`` so a later
@@ -140,19 +151,47 @@ class TPUCluster(object):
         error propagation, ``TFCluster.py:177-181``)."""
         try:
             if retry_policy is not None:
-                self._dispatch_with_retry(partitions, fn, retry_policy)
+                self._dispatch_with_retry(partitions, fn, retry_policy,
+                                          fn_factory)
             else:
                 self.backend.foreach_partition(partitions, fn)
         except Exception as e:
             self._latch_error(e)
             raise
 
-    def _dispatch_with_retry(self, partitions, fn, policy):
+    def _await_replacement(self, timeout=30):
+        """After a node death, give the elastic replacement a bounded window
+        to claim the freed slot and re-complete the roster, then refresh
+        ``cluster_info`` in place.  Returns True if the roster changed (a
+        retry must rebuild its feed closure); an unfilled roster just means
+        the retry shrinks onto the survivors — PR-1 semantics."""
+        refilled = self.server.reservations.wait(timeout=timeout)
+        if not refilled:
+            logger.warning(
+                "no replacement admitted within %.0fs (released slots: %s); "
+                "retrying on the surviving nodes only", timeout,
+                self.server.reservations.released_slots())
+        info = self.server.reservations.get()
+        info.sort(key=node._sort_key)
+        changed = info != self.cluster_info
+        if changed:
+            self.cluster_info[:] = info
+            logger.info(
+                "roster refreshed at generation %d: %s",
+                self.server.reservations.generation,
+                [(n["job_name"], n["task_index"], n["executor_id"])
+                 for n in info])
+        return changed
+
+    def _dispatch_with_retry(self, partitions, fn, policy, fn_factory=None):
         """Supervised feed dispatch: wait for the job to SETTLE (every task
         terminal — retrying while a sibling is still feeding would
         double-ship its partition), then re-dispatch only the failed
         partitions, with the policy's backoff, while every failure stays
-        retryable and attempts remain."""
+        retryable and attempts remain.  When the liveness monitor admitted a
+        replacement node in the meantime, the retry waits for its admission
+        and re-dispatches onto the refreshed roster — failed partitions land
+        on the replacement (or the survivors) instead of only shrinking."""
         if not getattr(self.backend, "supports_task_retry", False):
             # Job-level backends (Spark) can't observe per-partition task
             # outcomes, and re-running the whole job would double-feed the
@@ -186,6 +225,10 @@ class TPUCluster(object):
                 len(failed), len(pending), delay, attempt + 2,
                 policy.max_attempts, errors[0])
             time.sleep(delay)
+            if (self.tf_status.get("dead_nodes")
+                    and self._await_replacement()
+                    and fn_factory is not None):
+                fn = fn_factory()
             pending = [pending[i] for i, _ in failed]
         raise AssertionError("unreachable")  # pragma: no cover
 
@@ -459,9 +502,13 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
 
     # Role template: {job_name: [executor_ids]} (reference TFCluster.py:250-264).
     num_workers = num_executors - num_ps - (1 if eval_node else 0)
-    assert num_workers > 0, (
-        "num_executors={} leaves no workers after num_ps={} eval_node={}".format(
-            num_executors, num_ps, eval_node))
+    if num_workers <= 0:
+        # ValueError, not assert: this guards USER configuration, and an
+        # assert vanishes under ``python -O`` (the roster would then wedge
+        # the rendezvous with zero workers ever registering).
+        raise ValueError(
+            "num_executors={} leaves no workers after num_ps={} eval_node={}".format(
+                num_executors, num_ps, eval_node))
     executors = list(range(num_executors))
     cluster_template = {}
     if num_ps > 0:
@@ -480,8 +527,62 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
 
     # Shared driver-side status dict: async start-job failures land in
     # 'error' (fatal); the liveness monitor appends to 'dead_nodes'
-    # (recoverable — a supervised retry may complete the run regardless).
+    # (recoverable — a supervised retry may complete the run regardless);
+    # replacement admissions land in 'replacements'; clean BYE reasons
+    # ('done' / 'preempted') land in 'byes' keyed by executor id.
     tf_status = {}
+
+    # The replacement path needs the start-task closure, which is built
+    # AFTER the server (the closure captures cluster_meta, which carries the
+    # server address) — a mutable cell bridges the ordering.
+    elastic = {"start_fn": None}
+
+    def _request_replacement(meta):
+        """Elastic recovery: release the dead node's roster slot and spawn a
+        fresh executor into it (built-in backend).  Returns True when a
+        replacement was dispatched; False leaves the PR-1 semantics (fence
+        only, roster abort on bring-up death) untouched."""
+        start_fn = elastic.get("start_fn")
+        if (start_fn is None
+                or not getattr(cluster_backend, "supports_replacement", False)
+                or meta.get("executor_id") is None
+                or meta.get("job_name") is None):
+            return False
+        released = server.release_slot(meta["executor_id"])
+        if released is None:
+            return False  # died before registering: nothing to reclaim
+        try:
+            new_index = cluster_backend.provision_replacement()
+            handle = cluster_backend.run_on(
+                new_index, start_fn,
+                [{"executor_id": new_index,
+                  "job_name": released["job_name"],
+                  "task_index": released["task_index"]}])
+        except Exception:
+            logger.exception("replacement provisioning failed; the run "
+                             "continues on the surviving nodes")
+            return False
+        desc = "executor {} replaces {} as {}:{}".format(
+            new_index, meta["executor_id"], released["job_name"],
+            released["task_index"])
+        tf_status.setdefault("replacements", []).append(desc)
+        logger.warning("elastic recovery: %s", desc)
+
+        def _watch():
+            try:
+                handle.wait_settled(timeout=reservation_timeout)
+            except Exception:
+                pass
+            failed = handle.failed_tasks()
+            if failed:
+                logger.error("replacement start task failed:\n%s",
+                             failed[0][1])
+                tf_status.setdefault("replacement_errors", []).append(
+                    failed[0][1])
+
+        threading.Thread(target=_watch, name="replacement-watch",
+                         daemon=True).start()
+        return True
 
     def _on_dead(meta, age):
         desc = ("node {}:{} (executor {}) on {} declared dead after {:.1f}s "
@@ -492,12 +593,16 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         if (hasattr(cluster_backend, "exclude")
                 and meta.get("executor_id") is not None):
             cluster_backend.exclude(meta["executor_id"])
+        _request_replacement(meta)
+
+    def _on_bye(executor_id, reason):
+        tf_status.setdefault("byes", {})[str(executor_id)] = reason
 
     # Rendezvous server (reference TFCluster.py:277-279) + liveness monitor.
     server = reservation.Server(num_executors,
                                 heartbeat_interval=heartbeat_interval,
                                 heartbeat_misses=heartbeat_misses,
-                                on_dead=_on_dead)
+                                on_dead=_on_dead, on_bye=_on_bye)
     server_addr = server.start()
 
     cluster_meta = {
@@ -522,6 +627,12 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                         log_dir=log_dir, queues=tuple(queues),
                         background=background, release_port=release_port,
                         profiler=profiler)
+    # Replacement admission re-runs this same start closure on the fresh
+    # executor (the role travels as an explicit assignment item, see
+    # node.run) — SPARK-mode nodes run the user fn in a background child,
+    # so a replacement can join mid-run without holding a task slot.
+    if background:
+        elastic["start_fn"] = start_fn
     if driver_ps_nodes:
         # ps roles run in driver daemon threads (reference
         # TFCluster.py:291-309): the backend's start job covers only the

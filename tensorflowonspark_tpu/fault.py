@@ -79,6 +79,9 @@ RETRYABLE_PATTERNS = (
     r"connection(error| refused| reset)",
     r"broken pipe",
     r"\beoferror\b",
+    # transiently true while an elastic replacement is being admitted: the
+    # retry waits for the admission and re-dispatches onto the new roster
+    r"unschedulable: no live executors",
 )
 
 #: Exception types that are retryable regardless of message.
@@ -203,6 +206,12 @@ class _NullInjector(object):
     def maybe_fail(self, where):
         pass
 
+    def arm_preempt_notice(self):
+        pass
+
+    def corrupt_checkpoint(self, directory):
+        pass
+
 
 NULL = _NullInjector()
 
@@ -215,8 +224,20 @@ class FaultInjector(object):
     - ``kill_after_items``: SIGKILL this process once the data feed has
       handed out N items (the "node dies at step N" fault — an unannounced
       death the liveness monitor must catch).
+    - ``sigterm_at_item``: SIGTERM this process once the data feed has
+      handed out N items — an ANNOUNCED preemption: the node's SIGTERM
+      drain (stop feeding, emergency checkpoint, ``BYE reason=preempted``)
+      must run instead of a heartbeat-timeout death.
+    - ``preempt_notice``: seconds of advance warning a preemption notice
+      gives; :meth:`arm_preempt_notice` (called when the node's user fn
+      starts) arms a timer that SIGTERMs the process after that delay —
+      the cloud-provider "instance going away in N seconds" shape.
     - ``fail_after_items``: raise :class:`InjectedFailure` (``message``)
       once N items were consumed (a user-code failure at step N).
+    - ``corrupt_checkpoint``: garble the newest checkpoint step directory
+      the next time :meth:`corrupt_checkpoint` fires (wired into
+      ``CheckpointManager.maybe_save``) — recovery must then fall back to
+      the previous retained step (``restore_latest_valid``).
     - ``kill_after_tasks``: SIGKILL the built-in backend's executor process
       after serving N tasks (whole-executor loss).
     - ``drop_heartbeats_after``: heartbeat sender emits N beats, then goes
@@ -272,13 +293,20 @@ class FaultInjector(object):
 
     def on_items(self, n=1):
         """Data-feed consumption hook: count ``n`` consumed items and fire
-        ``kill_after_items`` / ``fail_after_items`` when crossed."""
+        ``kill_after_items`` / ``sigterm_at_item`` / ``fail_after_items``
+        when crossed."""
         self._items += n
         kill_at = self.spec.get("kill_after_items")
         if kill_at is not None and self._items >= kill_at:
             logger.warning("FaultInjector: killing pid %d after %d items",
                            os.getpid(), self._items)
             self._kill_self()
+        term_at = self.spec.get("sigterm_at_item")
+        if term_at is not None and self._items >= term_at:
+            self.spec.pop("sigterm_at_item")  # fire once
+            logger.warning("FaultInjector: SIGTERM (preemption) to pid %d "
+                           "after %d items", os.getpid(), self._items)
+            os.kill(os.getpid(), signal.SIGTERM)
         fail_at = self.spec.get("fail_after_items")
         if fail_at is not None and self._items >= fail_at:
             self.spec.pop("fail_after_items")  # fire once
@@ -326,6 +354,56 @@ class FaultInjector(object):
         if self.spec.get("fail_at") == where:
             fail(self.spec.get("message",
                                "injected failure at {}".format(where)))
+
+    def arm_preempt_notice(self):
+        """Arm the ``preempt_notice`` timer: a daemon timer SIGTERMs this
+        process after the configured delay, simulating a cloud preemption
+        notice arriving mid-run.  Call once when the node's user fn starts
+        (wired into the node wrappers); unarmed specs are a no-op."""
+        delay = self.spec.get("preempt_notice")
+        if not delay:
+            return
+        self.spec.pop("preempt_notice")  # arm once
+        import threading
+
+        def _notify():
+            logger.warning("FaultInjector: preemption notice expired; "
+                           "SIGTERM to pid %d", os.getpid())
+            os.kill(os.getpid(), signal.SIGTERM)
+
+        t = threading.Timer(delay, _notify)
+        t.daemon = True
+        t.start()
+
+    def corrupt_checkpoint(self, directory):
+        """Garble the newest checkpoint step under ``directory`` (fires
+        once): every regular file in the step dir is truncated and
+        overwritten with garbage, so a restore of that step fails and
+        recovery must fall back to the previous retained step."""
+        if not self.spec.get("corrupt_checkpoint"):
+            return
+        steps = []
+        try:
+            for name in os.listdir(directory):
+                if name.isdigit() and os.path.isdir(
+                        os.path.join(directory, name)):
+                    steps.append(int(name))
+        except OSError:
+            return
+        if not steps:
+            return  # nothing saved yet: stay armed for the next save
+        self.spec.pop("corrupt_checkpoint")  # fire once
+        step_dir = os.path.join(directory, str(max(steps)))
+        logger.warning("FaultInjector: corrupting checkpoint step dir %s",
+                       step_dir)
+        for root, _, files in os.walk(step_dir):
+            for fname in files:
+                path = os.path.join(root, fname)
+                try:
+                    with open(path, "wb") as f:
+                        f.write(b"\xde\xad\xbe\xef")
+                except OSError:
+                    pass
 
     @staticmethod
     def _kill_self():

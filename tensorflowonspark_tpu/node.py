@@ -25,13 +25,15 @@ import json
 import logging
 import multiprocessing
 import os
+import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
-from tensorflowonspark_tpu import manager, marker, reservation, util
+from tensorflowonspark_tpu import fault, manager, marker, reservation, util
 
 logger = logging.getLogger(__name__)
 
@@ -49,6 +51,80 @@ _JAX_JOBS = ("chief", "master", "worker")
 # start task returns — BaseManager shuts its server down when the handle is
 # garbage collected, and the node must outlive the start task in SPARK mode.
 _node_state = {}
+
+# ---------------------------------------------------------------------------
+# Preemption drain (SIGTERM): a preempted host must stop feed consumption,
+# land an emergency checkpoint, and deregister cleanly (BYE reason=preempted)
+# instead of dying by heartbeat timeout.  The node wrappers install the
+# handler in the process running the user fn; interested parties register
+# callbacks (the DataFeed registers its drain in get_data_feed; the trainer's
+# supervision registers the emergency save in train.fit_supervised).
+# ---------------------------------------------------------------------------
+
+_preempt_event = threading.Event()
+_preempt_callbacks = []  # run FIFO: feed drain first, then emergency save
+
+
+def on_preemption(callback):
+    """Register ``callback()`` to run when this process receives SIGTERM
+    (preemption).  Callbacks run in registration order inside the signal
+    handler, so keep them short and idempotent; after they return the
+    handler raises ``SystemExit(0)`` to unwind the user fn cleanly.
+    Returns the callback (usable as a decorator)."""
+    _preempt_callbacks.append(callback)
+    return callback
+
+
+def remove_preemption_callback(callback):
+    """Deregister a preemption callback (no-op if absent)."""
+    try:
+        _preempt_callbacks.remove(callback)
+    except ValueError:
+        pass
+
+
+def preempted():
+    """True once this process received a preemption SIGTERM."""
+    return _preempt_event.is_set()
+
+
+def _reset_preemption():
+    """Fresh preemption state (a forked node child inherits the parent's
+    registrations; tests reuse the module in-process)."""
+    global _preempt_event
+    _preempt_event = threading.Event()
+    del _preempt_callbacks[:]
+
+
+def _sigterm_drain(signum, frame):
+    """SIGTERM handler: run the registered drain callbacks once, then exit
+    cleanly.  A second SIGTERM while draining is ignored (schedulers often
+    send TERM twice before escalating to KILL)."""
+    if _preempt_event.is_set():
+        return
+    _preempt_event.set()
+    logger.warning("SIGTERM received: preemption drain (stopping feed, "
+                   "emergency checkpoint, clean BYE)")
+    for cb in list(_preempt_callbacks):
+        try:
+            cb()
+        except Exception:
+            logger.exception("preemption callback %r failed", cb)
+    raise SystemExit(0)
+
+
+def _install_sigterm_drain():
+    """Install the preemption handler; False when impossible (signal
+    handlers can only be installed from the main thread — e.g. Spark
+    executors run tasks on worker threads, where the preemption story is
+    Spark's own task re-land instead)."""
+    try:
+        signal.signal(signal.SIGTERM, _sigterm_drain)
+        return True
+    except ValueError:
+        logger.info("not on the main thread; SIGTERM preemption drain "
+                    "not installed")
+        return False
 
 
 class TPUNodeContext(object):
@@ -133,7 +209,12 @@ class TPUNodeContext(object):
         node's queues (reference ``TFNode.py:86``)."""
         from tensorflowonspark_tpu.datafeed import DataFeed
 
-        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+        feed = DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+        # On preemption the feed must stop consuming first (before the
+        # emergency checkpoint), so feeders unblock instead of pushing into a
+        # dying node; drain order is registration order.
+        on_preemption(feed.terminate)
+        return feed
 
     def absolute_path(self, path):
         """Normalize a user path against CWD/default_fs (reference ``TFNode.py:23-58``)."""
@@ -203,24 +284,39 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
     def _mapfn(iterator):
         # The start job parallelizes range(num_executors) with one element per
         # partition; that element is this node's executor id
-        # (reference TFCluster.py:312-316, TFSparkNode.py:148).
+        # (reference TFCluster.py:312-316, TFSparkNode.py:148).  An elastic
+        # REPLACEMENT start task instead carries an explicit assignment dict
+        # {executor_id, job_name, task_index}: the fresh executor is not in
+        # the original template, and the role it must claim is the dead
+        # node's released slot (see cluster.run's _request_replacement).
         executor_id = None
         for item in iterator:
             executor_id = item
         assert executor_id is not None, "start task received an empty partition"
+        assignment = None
+        if isinstance(executor_id, dict):
+            assignment = executor_id
+            executor_id = assignment["executor_id"]
 
-        # Claim role from the template (reference TFSparkNode.py:148-158).
-        job_name, task_index = None, -1
-        for job, executors in cluster_meta["cluster_template"].items():
-            if executor_id in executors:
-                job_name = job
-                task_index = executors.index(executor_id)
-                break
-        assert job_name is not None, (
-            "executor_id {} not present in cluster template {}".format(
-                executor_id, cluster_meta["cluster_template"])
-        )
-        logger.info("executor_id=%d assigned role %s:%d", executor_id, job_name, task_index)
+        # Claim role from the assignment or the template (reference
+        # TFSparkNode.py:148-158).
+        if assignment is not None:
+            job_name = assignment["job_name"]
+            task_index = assignment["task_index"]
+        else:
+            job_name, task_index = None, -1
+            for job, executors in cluster_meta["cluster_template"].items():
+                if executor_id in executors:
+                    job_name = job
+                    task_index = executors.index(executor_id)
+                    break
+            assert job_name is not None, (
+                "executor_id {} not present in cluster template {}".format(
+                    executor_id, cluster_meta["cluster_template"])
+            )
+        logger.info("executor_id=%d assigned role %s:%d%s", executor_id,
+                    job_name, task_index,
+                    " (replacement)" if assignment is not None else "")
 
         # Apply cluster-level env (TPU/XLA perf knobs, device_info.tpu_env)
         # FIRST: libtpu/XLA read these only when the jax client is created,
@@ -394,8 +490,16 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             hb = reservation.HeartbeatSender(
                 cluster_meta["server_addr"], executor_id,
                 heartbeat_interval).start()
+            # Forked children inherit the parent's preemption registrations;
+            # start from a clean slate, then install the SIGTERM drain in the
+            # process that actually runs the user fn.
+            _reset_preemption()
+            _install_sigterm_drain()
+            fault.from_env().arm_preempt_notice()
+            reason = None
             try:
                 wrapper_fn(args, context)
+                reason = "done"
             except Exception:
                 try:
                     errq.put(traceback.format_exc())
@@ -409,7 +513,9 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                                    "shutdown; traceback follows in log")
                 raise
             finally:
-                hb.stop()
+                if preempted():
+                    reason = "preempted"
+                hb.stop(reason=reason)
 
         if job_name in ("ps", "evaluator") or background:
             # Run the user fn in a child process; ps/evaluator then park this
@@ -448,13 +554,20 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             hb = reservation.HeartbeatSender(
                 cluster_meta["server_addr"], executor_id,
                 heartbeat_interval).start()
+            _reset_preemption()
+            _install_sigterm_drain()
+            fault.from_env().arm_preempt_notice()
+            reason = None
             try:
                 wrapper_fn(tf_args, ctx)
+                reason = "done"
             except Exception:
                 errq.put(traceback.format_exc())
                 raise
             finally:
-                hb.stop()
+                if preempted():
+                    reason = "preempted"
+                hb.stop(reason=reason)
                 mgr.set("state", "finished")
 
     return _mapfn

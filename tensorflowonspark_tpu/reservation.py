@@ -51,12 +51,21 @@ class Reservations(object):
     instead of silently over-filling the roster — a speculatively re-run
     start task or a stale executor from a prior cluster must not corrupt the
     rendezvous every healthy node is blocked on.
+
+    Elastic membership: the roster carries a monotonically increasing
+    ``generation``.  When the liveness monitor fences a node, its
+    ``(job_name, task_index)`` slot is *released* (:meth:`release`) so a
+    replacement registration can claim it; the admission that re-fills a
+    released slot bumps the generation, which is how waiters distinguish
+    "the original roster" from "the roster after a membership change".
     """
 
     def __init__(self, required):
         self.required = required
+        self.generation = 0
         self._lock = threading.Condition()
         self._reservations = []
+        self._released = []  # freed (job_name, task_index) slots awaiting a claim
 
     @staticmethod
     def _identity(meta):
@@ -82,8 +91,57 @@ class Reservations(object):
                     "extra registration {}".format(
                         len(self._reservations), self.required, key[1:]))
             self._reservations.append(meta)
+            if self._claim_released_slot(meta):
+                self.generation += 1
+                logger.info(
+                    "replacement %s admitted into released slot %s:%s; "
+                    "roster generation now %d", key[1:],
+                    meta.get("job_name", "?") if isinstance(meta, dict) else "?",
+                    meta.get("task_index", "?") if isinstance(meta, dict) else "?",
+                    self.generation)
             if self.done():
                 self._lock.notify_all()
+
+    def _claim_released_slot(self, meta):
+        """If ``meta`` fills a released slot, consume that slot and return
+        True (caller holds the lock).  Metas carrying a role claim their own
+        ``(job_name, task_index)``; bare metas (tests) claim any freed slot."""
+        if not self._released:
+            return False
+        if isinstance(meta, dict) and meta.get("job_name") is not None:
+            slot = (meta.get("job_name"), meta.get("task_index"))
+            if slot in self._released:
+                self._released.remove(slot)
+                return True
+            return False
+        self._released.pop(0)
+        return True
+
+    def release(self, executor_id):
+        """Release the slot held by ``executor_id`` (liveness fence): the
+        reservation is removed so a *replacement* identity may claim the
+        freed ``(job_name, task_index)``.  Returns the removed meta, or
+        ``None`` if the executor never held a reservation (e.g. it died
+        before registering)."""
+        with self._lock:
+            for i, meta in enumerate(self._reservations):
+                if (isinstance(meta, dict)
+                        and meta.get("executor_id") == executor_id):
+                    del self._reservations[i]
+                    self._released.append(
+                        (meta.get("job_name"), meta.get("task_index")))
+                    logger.warning(
+                        "released slot %s:%s of fenced executor %s for "
+                        "replacement admission", meta.get("job_name", "?"),
+                        meta.get("task_index", "?"), executor_id)
+                    return meta
+        return None
+
+    def released_slots(self):
+        """Snapshot of freed ``(job_name, task_index)`` slots not yet
+        reclaimed by a replacement."""
+        with self._lock:
+            return list(self._released)
 
     def notify_waiters(self):
         """Wake every ``wait()``er for an out-of-band re-check (used by the
@@ -149,7 +207,7 @@ class Server(MessageSocket):
     """
 
     def __init__(self, count, heartbeat_interval=0, heartbeat_misses=3,
-                 on_dead=None):
+                 on_dead=None, on_bye=None):
         """Args:
           count: required number of reservations.
           heartbeat_interval: expected seconds between node ``HBEAT``s;
@@ -158,7 +216,12 @@ class Server(MessageSocket):
             declared dead (deadline = interval × misses).
           on_dead: optional ``fn(meta, age_secs)`` callback fired once per
             dead node from the listener thread (the driver wires it to
-            ``tf_status`` latching and backend executor exclusion).
+            ``tf_status`` latching, backend executor exclusion, and — when
+            the backend supports it — slot release + replacement admission).
+          on_bye: optional ``fn(executor_id, reason)`` callback fired on a
+            clean ``BYE`` deregistration that carries a reason (``done`` /
+            ``preempted``) — how the driver tells clean completion from a
+            preemption drain in ``tf_status``.
         """
         assert count > 0
         self.reservations = Reservations(count)
@@ -166,20 +229,40 @@ class Server(MessageSocket):
         self.heartbeat_interval = heartbeat_interval
         self.heartbeat_misses = heartbeat_misses
         self.on_dead = on_dead
+        self.on_bye = on_bye
         self._stopping = False  # set by stop(): winds the listener down
         self._socket = None
         self._thread = None
-        self._parked = []  # AWAIT connections waiting for roster completion
+        # AWAIT connections waiting for roster completion: sock -> minimum
+        # roster generation the client asked to observe (0 = any).
+        self._parked = {}
         # Liveness state, touched only by the listener thread plus read-only
         # snapshots below: executor_id -> (last-beat monotonic time, meta).
         self._beats = {}
         self._dead = {}  # executor_id -> human-readable death description
+        self._released_ids = set()  # dead executors whose slot was released
+        self._byes = {}  # executor_id -> BYE reason (when one was given)
 
     # -- liveness ---------------------------------------------------------
 
     def dead_nodes(self):
         """Snapshot of dead-node descriptions, keyed by executor id."""
         return dict(self._dead)
+
+    def bye_reasons(self):
+        """Snapshot of clean-deregistration reasons, keyed by executor id."""
+        return dict(self._byes)
+
+    def release_slot(self, executor_id):
+        """Release the fenced executor's roster slot for replacement
+        admission (see :meth:`Reservations.release`).  The executor itself
+        stays dead — only a *fresh* identity may claim the freed slot; the
+        zombie's registrations and beats remain fenced.  Returns the
+        released node meta, or ``None``."""
+        meta = self.reservations.release(executor_id)
+        if meta is not None:
+            self._released_ids.add(executor_id)
+        return meta
 
     def _watch(self, meta):
         """Start tracking a registered node (registration counts as beat 0,
@@ -224,39 +307,64 @@ class Server(MessageSocket):
                 del self._beats[executor_id]
                 newly_dead.append((meta, age))
         if newly_dead:
-            # Wake await_reservations NOW rather than at its next poll.
-            self.reservations.notify_waiters()
+            # Fire on_dead BEFORE waking waiters: the callback may release
+            # the dead node's slot for replacement (cluster.run), and a
+            # waiter woken in between would mis-read the death as
+            # unrecoverable and abort a roster a replacement can still fill.
             if self.on_dead is not None:
                 for meta, age in newly_dead:
                     try:
                         self.on_dead(meta, age)
                     except Exception:
                         logger.exception("on_dead callback failed")
+            # Wake await_reservations NOW rather than at its next poll.
+            self.reservations.notify_waiters()
 
-    def _forget(self, executor_id):
+    def _forget(self, executor_id, reason=None):
         """Clean deregistration (``BYE``): the node finished on purpose, so
-        silence from here on is not a death."""
+        silence from here on is not a death.  ``reason`` (``done`` /
+        ``preempted``) is recorded and surfaced via ``on_bye``."""
         self._beats.pop(executor_id, None)
+        if reason is not None:
+            self._byes[executor_id] = reason
+            if self.on_bye is not None:
+                try:
+                    self.on_bye(executor_id, reason)
+                except Exception:
+                    logger.exception("on_bye callback failed")
 
-    def await_reservations(self, status=None, timeout=600):
+    def _unrecovered_dead(self):
+        """Dead-node descriptions for nodes whose slot was NOT released for
+        replacement — the deaths that make the roster unfillable."""
+        return [d for ex, d in self._dead.items()
+                if ex not in self._released_ids]
+
+    def await_reservations(self, status=None, timeout=600, generation=None):
         """Block the driver until all nodes registered (reference 111-126).
 
         ``status`` is a shared dict; if an async job-launcher thread records an
         ``'error'`` key there, waiting aborts immediately (reference
         ``reservation.py:117-120`` + ``TFCluster.py:321-323``).  A node the
-        liveness monitor declared dead also aborts immediately — a roster
-        that can never complete must not hang for the full timeout.
+        liveness monitor declared dead also aborts immediately — UNLESS its
+        slot was released for replacement admission (elastic recovery), in
+        which case the wait continues until the replacement fills the slot
+        or the timeout expires.  ``generation`` additionally requires the
+        roster generation to have reached that value (wait out a specific
+        membership change).
         """
         deadline = time.time() + timeout
-        while not self.reservations.done():
+        while (not self.reservations.done()
+               or (generation is not None
+                   and self.reservations.generation < generation)):
             if status and "error" in status:
                 raise Exception(
                     "Cluster startup failed on an executor: {}".format(status["error"])
                 )
-            if self._dead:
+            unrecovered = self._unrecovered_dead()
+            if unrecovered:
                 raise Exception(
                     "Cluster startup failed: node(s) died during bring-up: "
-                    "{}".format("; ".join(self._dead.values())))
+                    "{}".format("; ".join(unrecovered)))
             if time.time() > deadline:
                 raise Exception(
                     "Timed out waiting for cluster reservations after {}s: "
@@ -281,13 +389,26 @@ class Server(MessageSocket):
         """
         mtype = msg.get("type")
         if mtype == "REG":
+            meta = msg["data"]
+            # Zombie fence: a fenced executor_id must never re-enter the
+            # roster, even into its own released slot — the replacement has
+            # to be a FRESH identity, or a half-dead original racing its
+            # replacement could double-claim the role.
+            ex = meta.get("executor_id") if isinstance(meta, dict) else None
+            if ex is not None and ex in self._dead:
+                err = ("executor {} was fenced by the liveness monitor; a "
+                       "replacement must register with a fresh identity"
+                       .format(ex))
+                logger.warning("rejecting registration: %s", err)
+                self.send(sock, {"type": "ERR", "error": err})
+                return True
             try:
-                self.reservations.add(msg["data"])
+                self.reservations.add(meta)
             except ValueError as e:
                 logger.warning("rejecting registration: %s", e)
                 self.send(sock, {"type": "ERR", "error": str(e)})
                 return True
-            self._watch(msg["data"])
+            self._watch(meta)
             self.send(sock, {"type": "OK"})
         elif mtype == "HBEAT":
             executor_id = (msg.get("data") or {}).get("executor_id")
@@ -301,22 +422,32 @@ class Server(MessageSocket):
                                  "error": "marked dead by the liveness "
                                           "monitor"})
         elif mtype == "BYE":
-            executor_id = (msg.get("data") or {}).get("executor_id")
+            data = msg.get("data") or {}
+            executor_id = data.get("executor_id")
             if executor_id is not None:
-                self._forget(executor_id)
+                self._forget(executor_id, reason=data.get("reason"))
             self.send(sock, {"type": "OK"})
         elif mtype == "QUERY":
             self.send(sock, {"type": "QUERY", "done": self.reservations.done()})
         elif mtype == "QINFO":
+            generation = self.reservations.generation
             if self.reservations.done():
-                self.send(sock, {"type": "INFO", "data": self.reservations.get()})
+                self.send(sock, {"type": "INFO",
+                                 "data": self.reservations.get(),
+                                 "generation": generation})
             else:
-                self.send(sock, {"type": "INFO", "data": None})
+                self.send(sock, {"type": "INFO", "data": None,
+                                 "generation": generation})
         elif mtype == "AWAIT":
-            if self.reservations.done():
-                self.send(sock, {"type": "INFO", "data": self.reservations.get()})
+            want_gen = (msg.get("data") or {}).get("generation") or 0
+            if (self.reservations.done()
+                    and self.reservations.generation >= want_gen):
+                self.send(sock, {"type": "INFO",
+                                 "data": self.reservations.get(),
+                                 "generation": self.reservations.generation})
             elif sock not in parked:
-                parked.append(sock)  # answered when the roster completes
+                # answered when the roster completes at (or past) want_gen
+                parked[sock] = want_gen
         elif mtype == "STOP":
             logger.info("stop requested by client")
             self.done = True
@@ -365,22 +496,24 @@ class Server(MessageSocket):
                         except (EOFError, OSError, ValueError):
                             keep = False
                         if not keep:
-                            # Drop the fd from BOTH lists: a parked AWAIT
-                            # whose peer disconnected is readable (EOF) and
-                            # lands here — leaving it parked would leak the
-                            # fd until roster completion on long bring-ups.
+                            # Drop the fd from BOTH structures: a parked
+                            # AWAIT whose peer disconnected is readable (EOF)
+                            # and lands here — leaving it parked would leak
+                            # the fd until roster completion on long bring-ups.
                             conns.remove(sock)
-                            if sock in parked:
-                                parked.remove(sock)
+                            parked.pop(sock, None)
                             sock.close()
                 if parked and self.reservations.done():
                     info = self.reservations.get()
-                    for sock in parked:
+                    generation = self.reservations.generation
+                    for sock in [s for s, g in parked.items()
+                                 if generation >= g]:
                         try:
-                            self.send(sock, {"type": "INFO", "data": info})
+                            self.send(sock, {"type": "INFO", "data": info,
+                                             "generation": generation})
                         except OSError:
                             pass
-                    del parked[:]
+                        del parked[sock]
                 self._check_liveness()
 
         self._thread = threading.Thread(
@@ -471,17 +604,27 @@ class Client(MessageSocket):
                               "data": {"executor_id": executor_id}})
         return resp.get("type") == "OK"
 
-    def goodbye(self, executor_id):
+    def goodbye(self, executor_id, reason=None):
         """Clean liveness deregistration: this node is finishing on purpose,
-        so the monitor must not read its silence as a death."""
-        self._request({"type": "BYE", "data": {"executor_id": executor_id}})
+        so the monitor must not read its silence as a death.  ``reason``
+        (``done`` / ``preempted``) lets the driver tell clean completion
+        from a preemption drain in ``tf_status``."""
+        data = {"executor_id": executor_id}
+        if reason is not None:
+            data["reason"] = reason
+        self._request({"type": "BYE", "data": data})
 
     def get_reservations(self):
         """Non-blocking roster query; None until complete."""
         resp = self._request({"type": "QINFO"})
         return resp.get("data")
 
-    def await_reservations(self, timeout=600):
+    def get_generation(self):
+        """Current roster generation (bumps on each replacement admission)."""
+        resp = self._request({"type": "QINFO"})
+        return resp.get("generation", 0)
+
+    def await_reservations(self, timeout=600, generation=None):
         """Block until the roster is complete; returns cluster_info.
 
         Long-polls the server (single AWAIT request answered on completion)
@@ -489,9 +632,17 @@ class Client(MessageSocket):
         The AWAIT is sent exactly once; the client then waits on the socket —
         re-sending would double-park the connection server-side and could
         desync the message framing on a partial read.
+
+        ``generation`` asks for a roster at (or past) that generation: the
+        server holds the answer until the replacement admission that bumps
+        the generation has landed, so a waiter observing a membership change
+        never reads the pre-change roster back.
         """
         deadline = time.time() + timeout
-        self.send(self._sock, {"type": "AWAIT"})
+        msg = {"type": "AWAIT"}
+        if generation:
+            msg["data"] = {"generation": generation}
+        self.send(self._sock, msg)
         try:
             while True:
                 remaining = deadline - time.time()
@@ -586,14 +737,16 @@ class HeartbeatSender(object):
                                "fresh connection", e)
                 self._drop_client()
 
-    def stop(self, goodbye=True):
-        """Stop beating; with ``goodbye`` also deregister from the monitor."""
+    def stop(self, goodbye=True, reason=None):
+        """Stop beating; with ``goodbye`` also deregister from the monitor.
+        ``reason`` (``done`` / ``preempted``) travels with the BYE so the
+        driver can tell a preemption drain from ordinary completion."""
         self._stop.set()
         if self._thread.is_alive():
             self._thread.join(timeout=max(self.interval * 2, 5.0))
         if goodbye and not self.fenced and self.interval:
             try:
-                self._ensure_client().goodbye(self.executor_id)
+                self._ensure_client().goodbye(self.executor_id, reason=reason)
             except Exception as e:
                 logger.warning("BYE failed (%s); the driver may log a "
                                "spurious dead node", e)

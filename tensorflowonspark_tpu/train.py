@@ -494,16 +494,22 @@ class Trainer(object):
                 loss=None if last_loss is None else float(last_loss))
         return {}
 
-    def restore_latest(self, ckpt_manager):
+    def restore_latest(self, ckpt_manager, validate=False):
         """Restore the newest checkpoint INTO this trainer's state (same
         shardings — see :func:`~tensorflowonspark_tpu.checkpoint.abstract_state`);
         returns the restored step, or None when no checkpoint exists yet.
         The recovery half of the reference's story "Spark retries the job and
-        TF restores from the last checkpoint" (SURVEY §5.3)."""
+        TF restores from the last checkpoint" (SURVEY §5.3).
+
+        ``validate=True`` uses
+        :meth:`~tensorflowonspark_tpu.checkpoint.CheckpointManager.restore_latest_valid`:
+        a partial/corrupt newest step is quarantined and the previous
+        retained step restored instead of crashing recovery."""
         from tensorflowonspark_tpu import checkpoint as ckpt_mod
 
-        state, step = ckpt_manager.restore_latest(
-            ckpt_mod.abstract_state(self.state))
+        restore = (ckpt_manager.restore_latest_valid if validate
+                   else ckpt_manager.restore_latest)
+        state, step = restore(ckpt_mod.abstract_state(self.state))
         if step is None:
             return None
         self.state = state
@@ -534,29 +540,51 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
     Returns the final fit stats dict.
     """
     from tensorflowonspark_tpu import fault as fault_mod
+    from tensorflowonspark_tpu import node as node_mod
 
     policy = retry_policy or fault_mod.RetryPolicy()
-    for attempt in range(policy.max_attempts):
-        restored = trainer.restore_latest(ckpt_manager)
-        if restored is not None:
-            logger.info("supervised fit: resuming from checkpoint step %d",
-                        restored)
-        try:
-            stats = trainer.fit_feed(
-                feed_factory(), max_steps=max_steps,
-                steps_per_call=steps_per_call,
-                on_steps=lambda s: ckpt_manager.maybe_save(s, trainer.state))
-            ckpt_manager.maybe_save(int(trainer.state.step), trainer.state,
-                                    force=True)
-            ckpt_manager.wait_until_finished()
-            return stats
-        except Exception as e:
-            if not policy.is_retryable(e) or attempt + 1 >= policy.max_attempts:
-                raise
-            delay = policy.backoff(attempt)
-            logger.warning(
-                "supervised fit attempt %d/%d failed (%s: %s); restoring "
-                "latest checkpoint and retrying in %.1fs", attempt + 1,
-                policy.max_attempts, type(e).__name__, e, delay)
-            time.sleep(delay)
-    raise AssertionError("unreachable")  # pragma: no cover
+
+    def _emergency_save():
+        # Preemption drain: land whatever progress exists before the process
+        # unwinds.  Runs after the feed drain (registration order), so the
+        # step counter is final.  force=True bypasses the interval gate.
+        step = int(trainer.state.step)
+        logger.warning("preemption: emergency checkpoint at step %d", step)
+        ckpt_manager.maybe_save(step, trainer.state, force=True)
+        ckpt_manager.wait_until_finished()
+
+    # Chief-only: the emergency save runs inside a signal handler on ONE
+    # preempted host — it cannot be a cross-host collective, and on
+    # multi-host meshes a single host cannot write sharded state anyway.
+    # (Single-host worlds, where chaos tests live, are exactly where this
+    # works; multi-host preemption recovery rides the periodic saves.)
+    if ckpt_manager.is_chief:
+        node_mod.on_preemption(_emergency_save)
+    try:
+        for attempt in range(policy.max_attempts):
+            restored = trainer.restore_latest(ckpt_manager, validate=True)
+            if restored is not None:
+                logger.info("supervised fit: resuming from checkpoint step %d",
+                            restored)
+            try:
+                stats = trainer.fit_feed(
+                    feed_factory(), max_steps=max_steps,
+                    steps_per_call=steps_per_call,
+                    on_steps=lambda s: ckpt_manager.maybe_save(s, trainer.state))
+                ckpt_manager.maybe_save(int(trainer.state.step), trainer.state,
+                                        force=True)
+                ckpt_manager.wait_until_finished()
+                return stats
+            except Exception as e:
+                if (not policy.is_retryable(e)
+                        or attempt + 1 >= policy.max_attempts):
+                    raise
+                delay = policy.backoff(attempt)
+                logger.warning(
+                    "supervised fit attempt %d/%d failed (%s: %s); restoring "
+                    "latest checkpoint and retrying in %.1fs", attempt + 1,
+                    policy.max_attempts, type(e).__name__, e, delay)
+                time.sleep(delay)
+        raise AssertionError("unreachable")  # pragma: no cover
+    finally:
+        node_mod.remove_preemption_callback(_emergency_save)

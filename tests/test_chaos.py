@@ -207,3 +207,186 @@ def test_fit_supervised_fatal_error_raises_without_retry(tmp_path):
         assert len(calls) == 1
     finally:
         ckpt.close()
+
+
+# ---------------------------------------------------------------------------
+# elastic recovery
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos(timeout=240)
+def test_elastic_replacement_full_loop():
+    """The elastic flagship: SIGKILL one node mid-feed → the liveness monitor
+    fences it and RELEASES its roster slot → the backend provisions a fresh
+    executor whose start task claims the slot under a bumped generation →
+    the supervised retry waits for the admission and re-dispatches the
+    failed partition onto the refreshed roster → the run completes with
+    every partition fed exactly once, matching an uninterrupted run."""
+    spec = json.dumps({"kill_after_items": 5})
+    b = backend.LocalBackend(
+        3, env_per_executor=[{fault.FAULT_SPEC_ENV: spec}, None, None])
+    try:
+        c = cluster.run(b, _node_sum_fn, tf_args=[], num_executors=3,
+                        input_mode=InputMode.SPARK,
+                        heartbeat_interval=0.5, heartbeat_misses=2)
+        policy = fault.RetryPolicy(max_attempts=5, initial_backoff=1.5,
+                                   multiplier=1.5, jitter=0.3,
+                                   rng=random.Random(11))
+        c.train(backend.partition(range(30), 3), retry_policy=policy)
+        # the death was detected and named...
+        dead = c.tf_status.get("dead_nodes")
+        assert dead and "executor 0" in dead[0], c.tf_status
+        # ...its slot was reclaimed by a replacement under a new generation...
+        assert c.tf_status.get("replacements"), c.tf_status
+        assert "executor 3 replaces 0" in c.tf_status["replacements"][0]
+        assert "replacement_errors" not in c.tf_status, c.tf_status
+        assert c.server.reservations.generation >= 1
+        roster_ids = sorted(n["executor_id"] for n in c.cluster_info)
+        assert roster_ids == [1, 2, 3], c.cluster_info
+        # ...and the run is a SUCCESS, not a shrunken survivor crawl
+        assert "error" not in c.tf_status
+        c.shutdown(grace_secs=1)
+        # every partition fed exactly once: totals across the survivors AND
+        # the replacement equal the uninterrupted run's total
+        total = 0
+        for i in (1, 2, 3):
+            path = os.path.join(b.workdir_root, "executor-{}".format(i),
+                                "sum.txt")
+            if os.path.exists(path):
+                with open(path) as f:
+                    total += int(f.read())
+        assert total == sum(range(30))
+        # the killed node never completed
+        assert not os.path.exists(
+            os.path.join(b.workdir_root, "executor-0", "sum.txt"))
+    finally:
+        b.stop()
+
+
+@pytest.mark.chaos(timeout=180)
+def test_preemption_sigterm_drains_cleanly():
+    """Preemption drain e2e: SIGTERM one node mid-feed → its SIGTERM handler
+    stops feed consumption and exits cleanly with BYE reason=preempted —
+    NO heartbeat-timeout death, no failed feed task, no fatal latch."""
+    spec = json.dumps({"sigterm_at_item": 3})
+    b = backend.LocalBackend(
+        2, env_per_executor=[{fault.FAULT_SPEC_ENV: spec}, None])
+    try:
+        c = cluster.run(b, _node_sum_fn, tf_args=[], num_executors=2,
+                        input_mode=InputMode.SPARK,
+                        heartbeat_interval=0.5, heartbeat_misses=2)
+        c.train(backend.partition(range(20), 2), feed_timeout=60)
+        # the preempted node deregistered CLEANLY: reason surfaced, and its
+        # silence was never declared a death
+        deadline = time.time() + 10
+        while (c.tf_status.get("byes", {}).get("0") != "preempted"
+               and time.time() < deadline):
+            time.sleep(0.1)
+        assert c.tf_status.get("byes", {}).get("0") == "preempted", c.tf_status
+        assert not c.tf_status.get("dead_nodes"), c.tf_status
+        assert "error" not in c.tf_status
+        c.shutdown(grace_secs=1)
+        # the survivor finished its work normally
+        with open(os.path.join(b.workdir_root, "executor-1",
+                               "sum.txt")) as f:
+            int(f.read())  # parses: the node completed and persisted
+    finally:
+        b.stop()
+
+
+@pytest.mark.chaos(timeout=120)
+def test_preemption_emergency_checkpoint_then_resume(tmp_path):
+    """Preemption mid-training: the SIGTERM drain runs fit_supervised's
+    emergency save (force=True, past the interval gate), the process unwinds
+    with SystemExit(0), and a later fit_supervised resumes from the
+    emergency step — no training progress lost to the preemption."""
+    import signal as signal_mod
+
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint as ckpt_mod
+    from tensorflowonspark_tpu import manager
+    from tensorflowonspark_tpu import node as node_mod
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    from tensorflowonspark_tpu.parallel import build_mesh
+    from tensorflowonspark_tpu.parallel.infeed import ShardedFeed
+    from tensorflowonspark_tpu.train import Trainer, fit_supervised
+
+    mesh = build_mesh()
+    rng = np.random.RandomState(1)
+    rows = [([float(x) for x in rng.rand(2)],) for _ in range(32)]
+    rows = [(r[0], float(np.dot(r[0], [2.0, -1.0]))) for r in rows]
+
+    class _PreemptOnceFeed(object):
+        """SIGTERMs our own process after N batches; the installed drain
+        handler then runs the emergency save and raises SystemExit here."""
+
+        def __init__(self, inner, preempt_after):
+            self._inner = inner
+            self._preempt_after = preempt_after
+
+        def batches(self):
+            for i, item in enumerate(self._inner.batches()):
+                if (self._preempt_after is not None
+                        and i >= self._preempt_after):
+                    os.kill(os.getpid(), signal_mod.SIGTERM)
+                yield item
+
+        def terminate(self):
+            self._inner.terminate()
+
+    managers = []
+
+    def make_feed_factory(preempt_after):
+        def feed_factory():
+            m = manager.start(b"chaos-preempt-%d" % len(managers),
+                              ["input", "output", "error"])
+            managers.append(m)
+            q = m.get_queue("input")
+            for r in rows:
+                q.put(r)
+            q.put(None)
+            feed = DataFeed(m, input_mapping={"a_x": "x", "b_y": "y"})
+            sharded = ShardedFeed(feed, mesh, global_batch_size=8, prefetch=0)
+            return _PreemptOnceFeed(sharded, preempt_after)
+        return feed_factory
+
+    def loss(params, batch, mask):
+        pred = jnp.asarray(batch["x"]) @ params["w"]
+        err = (pred - jnp.asarray(batch["y"])) ** 2 * mask
+        return err.sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+    # interval 100 >> run length: ONLY the emergency save can land a step
+    ckpt = ckpt_mod.CheckpointManager(str(tmp_path / "ckpt"),
+                                      save_interval_steps=100)
+    old_handler = signal_mod.getsignal(signal_mod.SIGTERM)
+    try:
+        node_mod._reset_preemption()
+        assert node_mod._install_sigterm_drain()
+        trainer = Trainer(loss, {"w": jnp.zeros((2,))}, optax.sgd(0.05),
+                          mesh=mesh, batch_size=8, log_steps=2)
+        with pytest.raises(SystemExit):
+            fit_supervised(trainer, make_feed_factory(2), ckpt,
+                           retry_policy=fault.RetryPolicy(max_attempts=2))
+        assert node_mod.preempted()
+        # the emergency save landed the preempted step (interval gate bypassed)
+        assert ckpt.latest_step() == 2
+        # fit_supervised unregistered its drain callback on the way out
+        assert not node_mod._preempt_callbacks
+
+        # --- the replacement run: restore from the emergency step ----------
+        node_mod._reset_preemption()
+        trainer2 = Trainer(loss, {"w": jnp.zeros((2,))}, optax.sgd(0.05),
+                           mesh=mesh, batch_size=8, log_steps=2)
+        stats = fit_supervised(trainer2, make_feed_factory(None), ckpt,
+                               retry_policy=fault.RetryPolicy(max_attempts=2))
+        # resumed at 2, consumed the fresh 4-batch feed: 6 total
+        assert int(trainer2.state.step) == 6
+        assert ckpt.latest_step() == 6
+        assert "loss" in stats
+    finally:
+        signal_mod.signal(signal_mod.SIGTERM, old_handler)
+        node_mod._reset_preemption()
+        ckpt.close()
+        for m in managers:
+            m.shutdown()

@@ -344,7 +344,7 @@ def test_parked_await_pruned_on_disconnect():
     deadline = time.time() + 5
     while server._parked and time.time() < deadline:
         time.sleep(0.05)
-    assert server._parked == []
+    assert not server._parked
     server.stop()
 
 
@@ -372,3 +372,158 @@ def test_client_request_times_out_with_clear_error():
     finally:
         wedge.close()
     assert reservation.DEFAULT_REQUEST_TIMEOUT == 30.0  # finite by default
+
+
+# ---------------------------------------------------------------------------
+# elastic recovery: slot reclamation, generations, replacement admission
+# ---------------------------------------------------------------------------
+
+def test_release_and_replacement_bumps_generation():
+    """Releasing a fenced node's slot lets a FRESH executor id claim the
+    same role; admission bumps the roster generation."""
+    server = reservation.Server(2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0, "host": "h", "job_name": "worker",
+                     "task_index": 0})
+    client.register({"executor_id": 1, "host": "h", "job_name": "worker",
+                     "task_index": 1})
+    assert server.reservations.generation == 0
+    released = server.release_slot(0)
+    assert released["job_name"] == "worker" and released["task_index"] == 0
+    assert not server.reservations.done()
+    assert server.reservations.released_slots() == [("worker", 0)]
+    client.register({"executor_id": 7, "host": "h2", "job_name": "worker",
+                     "task_index": 0})  # replacement, fresh identity
+    assert server.reservations.done()
+    assert server.reservations.generation == 1
+    assert client.get_generation() == 1
+    roles = sorted((m["executor_id"], m["task_index"])
+                   for m in server.reservations.get())
+    assert roles == [(1, 1), (7, 0)]
+    client.close()
+    server.stop()
+
+
+def test_release_unknown_executor_is_noop():
+    server = reservation.Server(1)
+    server.start()
+    assert server.release_slot(42) is None
+    assert server.reservations.generation == 0
+    server.stop()
+
+
+def test_fenced_executor_id_cannot_reregister():
+    """The zombie fence extends to REG: the dead id must not reclaim its own
+    released slot — only a fresh identity may."""
+    server = reservation.Server(2, heartbeat_interval=0.1, heartbeat_misses=2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0, "host": "h", "job_name": "worker",
+                     "task_index": 0})
+    deadline = time.time() + 5
+    while not server.dead_nodes() and time.time() < deadline:
+        time.sleep(0.05)
+    server.release_slot(0)
+    with pytest.raises(Exception, match="fenced by the liveness monitor"):
+        client.register({"executor_id": 0, "host": "h", "job_name": "worker",
+                         "task_index": 0})
+    client.register({"executor_id": 5, "host": "h", "job_name": "worker",
+                     "task_index": 0})  # fresh identity: admitted
+    assert server.reservations.generation == 1
+    client.close()
+    server.stop()
+
+
+def test_await_survives_recovered_death():
+    """await_reservations must NOT abort on a death whose slot was released
+    for elastic replacement — only unrecovered deaths abort bring-up."""
+    server = reservation.Server(2, heartbeat_interval=0.1, heartbeat_misses=2)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0, "host": "h", "job_name": "worker",
+                     "task_index": 0})
+    deadline = time.time() + 5
+    while not server.dead_nodes() and time.time() < deadline:
+        time.sleep(0.05)
+    server.release_slot(0)
+
+    def _replace():
+        time.sleep(0.3)
+        c = reservation.Client(addr)
+        c.register({"executor_id": 9, "host": "h", "job_name": "worker",
+                    "task_index": 0})
+        c.register({"executor_id": 1, "host": "h", "job_name": "worker",
+                    "task_index": 1})
+        c.close()
+
+    t = threading.Thread(target=_replace, daemon=True)
+    t.start()
+    info = server.await_reservations(timeout=10)
+    assert len(info) == 2
+    t.join(timeout=5)
+    client.close()
+    server.stop()
+
+
+def test_await_generation_blocks_until_replacement():
+    """Client AWAIT with a target generation parks past roster completion
+    until a replacement admission bumps the generation."""
+    server = reservation.Server(1)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0, "host": "h", "job_name": "worker",
+                     "task_index": 0})
+    results = []
+
+    def _wait_gen1():
+        c = reservation.Client(addr)
+        results.append(c.await_reservations(timeout=10, generation=1))
+        c.close()
+
+    t = threading.Thread(target=_wait_gen1, daemon=True)
+    t.start()
+    time.sleep(0.4)
+    assert not results  # roster done, but generation 0 < 1: still parked
+    server.release_slot(0)
+    client.register({"executor_id": 3, "host": "h", "job_name": "worker",
+                     "task_index": 0})
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert results and results[0][0]["executor_id"] == 3
+    client.close()
+    server.stop()
+
+
+def test_bye_reason_recorded_and_surfaced():
+    server = reservation.Server(2, heartbeat_interval=0.1, heartbeat_misses=2)
+    addr = server.start()
+    reasons = {}
+    server.on_bye = lambda ex, reason: reasons.update({ex: reason})
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0, "host": "h", "job_name": "worker",
+                     "task_index": 0})
+    client.register({"executor_id": 1, "host": "h", "job_name": "worker",
+                     "task_index": 1})
+    client.goodbye(0, reason="preempted")
+    client.goodbye(1)  # plain BYE: deregisters but records no reason
+    assert server.bye_reasons() == {0: "preempted"}
+    assert reasons == {0: "preempted"}
+    time.sleep(0.5)
+    assert server.dead_nodes() == {}  # preempted exit is NOT a death
+    client.close()
+    server.stop()
+
+
+def test_heartbeat_sender_stop_reason():
+    server = reservation.Server(1, heartbeat_interval=0.1, heartbeat_misses=3)
+    addr = server.start()
+    client = reservation.Client(addr)
+    client.register({"executor_id": 0, "host": "h", "job_name": "worker",
+                     "task_index": 0})
+    sender = reservation.HeartbeatSender(addr, 0, interval=0.1).start()
+    time.sleep(0.3)
+    sender.stop(reason="preempted")
+    assert server.bye_reasons() == {0: "preempted"}
+    client.close()
+    server.stop()

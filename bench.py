@@ -373,15 +373,18 @@ def transformer_main(args, ctx):
 # ---------------------------------------------------------------------------
 
 def _run_cluster(main_fun, args, input_mode, feed_partitions=None,
-                 num_epochs=1, stats_timeout=600):
+                 num_epochs=1, stats_timeout=600, telemetry=False):
     """Drive one single-executor cluster end-to-end; returns the stats the
-    chief wrote."""
+    chief wrote (plus the cluster's final feed-plane counter aggregate
+    under ``feed_plane_counters`` when ``telemetry=True``)."""
     from tensorflowonspark_tpu import backend, cluster
 
     b = backend.LocalBackend(1)
+    tdir = os.path.join(tempfile.mkdtemp(), "telemetry") if telemetry else None
     try:
         c = cluster.run(b, main_fun, args, num_executors=1,
-                        input_mode=input_mode)
+                        input_mode=input_mode,
+                        telemetry=telemetry, telemetry_dir=tdir)
         if feed_partitions is not None:
             c.train(feed_partitions, num_epochs=num_epochs,
                     chunk_size=args.chunk_size)
@@ -394,10 +397,14 @@ def _run_cluster(main_fun, args, input_mode, feed_partitions=None,
                                        + args.stats_path)
                 time.sleep(0.5)
         c.shutdown(grace_secs=2)
+        counters = (c.tf_status.get("telemetry") or {}).get("aggregate")
     finally:
         b.stop()
     with open(args.stats_path) as f:
-        return json.load(f)
+        stats = json.load(f)
+    if telemetry and counters:
+        stats["feed_plane_counters"] = counters
+    return stats
 
 
 def measure_mnist_e2e(rows=MNIST_ROWS, batch_size=MNIST_BATCH,
@@ -517,7 +524,8 @@ def measure_feedplane(rows=MNIST_ROWS, epochs=None):
         stats_path=os.path.join(tempfile.mkdtemp(), "feed_stats.json"))
     return _run_cluster(
         feedplane_main, args, cluster.InputMode.SPARK,
-        feed_partitions=backend.partition(data, 8), num_epochs=epochs)
+        feed_partitions=backend.partition(data, 8), num_epochs=epochs,
+        telemetry=True)
 
 
 def measure_reference_feed_ceiling(n_items=60000):
@@ -856,6 +864,19 @@ def main():
         # pickled ring records vs in-queue fallback) — a throughput delta
         # across rounds means nothing without knowing the transport changed
         out["feed_plane_wire_formats"] = feedplane.get("wire_formats")
+        # aggregated telemetry counters from the leg's HBEAT stream: ring
+        # occupancy high-water (how full the shm ring ran — headroom left
+        # in the transport) and consumer backpressure stall time (seconds
+        # the consumer sat waiting on an empty queue)
+        counters = feedplane.get("feed_plane_counters") or {}
+        if counters:
+            out["feed_plane_counters"] = {
+                "ring_occupancy_hwm": counters.get("ring_occupancy_hwm"),
+                "backpressure_stall_secs": counters.get("feed_stall_secs"),
+                "feeder_items": counters.get("feeder_items"),
+                "feeder_bytes": counters.get("feeder_bytes"),
+                "queue_depth_hwm": counters.get("queue_depth_hwm"),
+            }
         if ceiling:
             out["feed_plane_vs_baseline"] = round(
                 feedplane["items_per_sec"] / ceiling["items_per_sec"], 2)

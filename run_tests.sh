@@ -27,8 +27,10 @@ rc=0
 python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 
 # the driver gates: compile-check the graft entry + the multi-chip dry run,
-# then prove the elastic-recovery loop closes on a real 3-node cluster
+# prove the elastic-recovery loop closes on a real 3-node cluster, then
+# prove the telemetry plane produces parseable traces + HBEAT counters
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
+python scripts/ci_assert_telemetry.py
 
 exit $rc

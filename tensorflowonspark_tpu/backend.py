@@ -282,6 +282,9 @@ class LocalBackend(object):
         if 0 <= executor_index < self.num_executors:
             self._excluded.add(executor_index)
             logger.warning("executor %d excluded from scheduling", executor_index)
+            from tensorflowonspark_tpu import telemetry
+            telemetry.get_tracer().instant("backend/executor_excluded",
+                                           executor_id=executor_index)
 
     def provision_replacement(self, env=None):
         """Spawn a FRESH executor process for elastic recovery; returns its
@@ -290,12 +293,14 @@ class LocalBackend(object):
         holding).  The new executor gets its own working directory and does
         NOT enter the free pool until its first task (the replacement start
         task dispatched via :meth:`run_on`) completes."""
-        with self._lock:
-            i = len(self._procs)
-            overrides = dict(self._base_env)
-            overrides.update(env or {})
-            self._spawn_executor(i, overrides)
-            self.num_executors = len(self._procs)
+        from tensorflowonspark_tpu import telemetry
+        with telemetry.get_tracer().span("backend/provision_replacement"):
+            with self._lock:
+                i = len(self._procs)
+                overrides = dict(self._base_env)
+                overrides.update(env or {})
+                self._spawn_executor(i, overrides)
+                self.num_executors = len(self._procs)
         logger.warning("provisioned replacement executor %d", i)
         return i
 

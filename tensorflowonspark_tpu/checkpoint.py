@@ -100,8 +100,12 @@ class CheckpointManager(object):
             return False  # already saved (e.g. final force after interval hit)
         import orbax.checkpoint as ocp
 
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(
-            _globalize(state)), force=force)
+        from tensorflowonspark_tpu import telemetry
+
+        with telemetry.get_tracer().span("checkpoint/save", step=step,
+                                         force=force):
+            saved = self._mgr.save(step, args=ocp.args.StandardSave(
+                _globalize(state)), force=force)
         if saved:
             logger.info("checkpointed step %d to %s", step, self.directory)
             if self._injector.enabled:
@@ -125,8 +129,11 @@ class CheckpointManager(object):
             return None, None
         import orbax.checkpoint as ocp
 
-        state = self._mgr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state))
+        from tensorflowonspark_tpu import telemetry
+
+        with telemetry.get_tracer().span("checkpoint/restore", step=step):
+            state = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state))
         logger.info("restored checkpoint step %d from %s", step, self.directory)
         return state, step
 
@@ -147,6 +154,9 @@ class CheckpointManager(object):
         when no valid checkpoint remains (train from scratch)."""
         import orbax.checkpoint as ocp
 
+        from tensorflowonspark_tpu import telemetry
+
+        tracer = telemetry.get_tracer()
         tried = set()
         while True:
             self._mgr.reload()
@@ -162,17 +172,20 @@ class CheckpointManager(object):
             tried.add(step)
             step_dir = os.path.join(self.directory, str(step))
             try:
-                if not os.path.isdir(step_dir) or not os.listdir(step_dir):
-                    raise ValueError(
-                        "step dir {} missing or empty (uncommitted "
-                        "save)".format(step_dir))
-                state = self._mgr.restore(
-                    step, args=ocp.args.StandardRestore(abstract_state))
+                with tracer.span("checkpoint/restore", step=step,
+                                 validated=True):
+                    if not os.path.isdir(step_dir) or not os.listdir(step_dir):
+                        raise ValueError(
+                            "step dir {} missing or empty (uncommitted "
+                            "save)".format(step_dir))
+                    state = self._mgr.restore(
+                        step, args=ocp.args.StandardRestore(abstract_state))
             except Exception:
                 logger.warning(
                     "checkpoint step %d failed validation; quarantining and "
                     "falling back to the previous retained step", step,
                     exc_info=True)
+                tracer.instant("checkpoint/quarantine", step=step)
                 self._quarantine(step_dir)
                 continue
             logger.info("restored validated checkpoint step %d from %s",

@@ -15,6 +15,7 @@ Input modes (reference ``TFCluster.py:41-44``):
 """
 
 import logging
+import os
 import random
 import signal
 import sys
@@ -24,6 +25,7 @@ import uuid
 
 from tensorflowonspark_tpu import backend as backend_mod
 from tensorflowonspark_tpu import node, reservation
+from tensorflowonspark_tpu import telemetry as telemetry_mod
 
 logger = logging.getLogger(__name__)
 
@@ -165,7 +167,9 @@ class TPUCluster(object):
         ``cluster_info`` in place.  Returns True if the roster changed (a
         retry must rebuild its feed closure); an unfilled roster just means
         the retry shrinks onto the survivors — PR-1 semantics."""
-        refilled = self.server.reservations.wait(timeout=timeout)
+        with telemetry_mod.get_tracer().span(
+                "cluster/replacement_wait", timeout_secs=timeout):
+            refilled = self.server.reservations.wait(timeout=timeout)
         if not refilled:
             logger.warning(
                 "no replacement admitted within %.0fs (released slots: %s); "
@@ -202,13 +206,16 @@ class TPUCluster(object):
                         type(self.backend).__name__)
             self.backend.foreach_partition(partitions, fn)
             return
+        tracer = telemetry_mod.get_tracer()
         parts = list(partitions)
         pending = list(range(len(parts)))  # indices into parts
         for attempt in range(policy.max_attempts):
-            handle = self.backend.foreach_partition_async(
-                [parts[i] for i in pending], fn)
-            handle.wait_settled()
-            failed = handle.failed_tasks()
+            with tracer.span("cluster/dispatch", attempt=attempt + 1,
+                             partitions=len(pending)):
+                handle = self.backend.foreach_partition_async(
+                    [parts[i] for i in pending], fn)
+                handle.wait_settled()
+                failed = handle.failed_tasks()
             if not failed:
                 return
             errors = [e for _, e in failed]
@@ -224,6 +231,8 @@ class TPUCluster(object):
                 "retrying in %.1fs (attempt %d/%d). First error:\n%s",
                 len(failed), len(pending), delay, attempt + 2,
                 policy.max_attempts, errors[0])
+            tracer.instant("cluster/retry", attempt=attempt + 1,
+                           failed=len(failed), delay_secs=delay)
             time.sleep(delay)
             if (self.tf_status.get("dead_nodes")
                     and self._await_replacement()
@@ -236,6 +245,25 @@ class TPUCluster(object):
         if "error" not in self.tf_status:
             self.tf_status["error"] = "{}: {}".format(
                 type(exc).__name__, exc)
+
+    def metrics_snapshot(self):
+        """Per-node feed-plane counters carried by heartbeats, plus the
+        cluster-wide aggregate (``_hwm``/``_max`` keys merge by max, the
+        rest sum).  Live while the cluster runs; ``shutdown()`` latches the
+        final snapshot into ``tf_status["telemetry"]``."""
+        return self.server.metrics_snapshot()
+
+    def _latch_telemetry(self):
+        """Latch the final metrics aggregate into ``tf_status`` and flush
+        the driver's trace buffer.  Runs on every shutdown path, including
+        the error exits — a failed run's timeline is the one you want."""
+        try:
+            snap = self.server.metrics_snapshot()
+            if snap.get("nodes"):
+                self.tf_status.setdefault("telemetry", snap)
+        except Exception:
+            logger.debug("telemetry latch failed", exc_info=True)
+        telemetry_mod.get_tracer().flush()
 
     def inference(self, data, qname="input", chunk_size=1024):
         """Feed data for inference, returning per-item results (reference
@@ -405,6 +433,7 @@ class TPUCluster(object):
 
         if "error" in self.tf_status:
             logger.error("cluster failed: %s", self.tf_status["error"])
+            self._latch_telemetry()
             self.backend.stop()
             if timer:
                 signal.alarm(0)
@@ -432,12 +461,14 @@ class TPUCluster(object):
             logger.warning("start job did not fully drain; continuing shutdown")
         except RuntimeError as e:
             logger.error("cluster failed: %s", e)
+            self._latch_telemetry()
             if timer:
                 signal.alarm(0)
             sys.exit(1)
 
         if timer:
             signal.alarm(0)
+        self._latch_telemetry()
         self.server.stop()
         logger.info("cluster stopped")
 
@@ -462,7 +493,8 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         master_node=None, reservation_timeout=600,
         queues=("input", "output", "error"), eval_node=False,
         release_port=True, profiler=False, executor_env=None,
-        driver_ps_nodes=False, heartbeat_interval=5.0, heartbeat_misses=3):
+        driver_ps_nodes=False, heartbeat_interval=5.0, heartbeat_misses=3,
+        telemetry=False, telemetry_dir=None):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -495,10 +527,24 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         ``await_reservations`` aborts immediately, and the executor is
         fenced off from further feed-task scheduling (built-in backend).
       heartbeat_misses: missed beats tolerated before declaring death.
+      telemetry: enable the cluster-wide telemetry plane (lifecycle span
+        traces, heartbeat-carried feed counters, hang flight recorder).
+        Off by default: when False no telemetry files are written and the
+        instrumentation reduces to no-op calls on a null tracer.
+      telemetry_dir: directory for per-process trace/flight files
+        (default: ``<log_dir>/telemetry``, or ``./telemetry`` without a
+        log_dir).  See docs/OBSERVABILITY.md.
     """
     if hasattr(cluster_backend, "parallelize"):  # raw SparkContext
         cluster_backend = backend_mod.SparkBackend(cluster_backend)
     num_executors = num_executors or cluster_backend.num_executors
+
+    tdir = None
+    if telemetry:
+        tdir = os.path.abspath(
+            telemetry_dir or os.path.join(log_dir or ".", "telemetry"))
+    tracer = telemetry_mod.configure(telemetry, tdir)
+    telemetry_mod.install_sigusr1()
 
     # Role template: {job_name: [executor_ids]} (reference TFCluster.py:250-264).
     num_workers = num_executors - num_ps - (1 if eval_node else 0)
@@ -552,12 +598,16 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         if released is None:
             return False  # died before registering: nothing to reclaim
         try:
-            new_index = cluster_backend.provision_replacement()
-            handle = cluster_backend.run_on(
-                new_index, start_fn,
-                [{"executor_id": new_index,
-                  "job_name": released["job_name"],
-                  "task_index": released["task_index"]}])
+            with tracer.span("cluster/replacement_provision",
+                             dead_executor=meta["executor_id"],
+                             job_name=released["job_name"],
+                             task_index=released["task_index"]):
+                new_index = cluster_backend.provision_replacement()
+                handle = cluster_backend.run_on(
+                    new_index, start_fn,
+                    [{"executor_id": new_index,
+                      "job_name": released["job_name"],
+                      "task_index": released["task_index"]}])
         except Exception:
             logger.exception("replacement provisioning failed; the run "
                              "continues on the surviving nodes")
@@ -567,6 +617,11 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
             released["task_index"])
         tf_status.setdefault("replacements", []).append(desc)
         logger.warning("elastic recovery: %s", desc)
+        tracer.instant("cluster/replacement_dispatched",
+                       new_executor=new_index,
+                       dead_executor=meta["executor_id"],
+                       job_name=released["job_name"],
+                       task_index=released["task_index"])
 
         def _watch():
             try:
@@ -590,6 +645,11 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                     meta.get("job_name", "?"), meta.get("task_index", "?"),
                     meta.get("executor_id", "?"), meta.get("host", "?"), age)
         tf_status.setdefault("dead_nodes", []).append(desc)
+        tracer.instant("cluster/node_dead",
+                       executor_id=meta.get("executor_id"),
+                       job_name=meta.get("job_name"),
+                       task_index=meta.get("task_index"),
+                       age_secs=round(age, 3))
         if (hasattr(cluster_backend, "exclude")
                 and meta.get("executor_id") is not None):
             cluster_backend.exclude(meta["executor_id"])
@@ -616,7 +676,11 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         "input_mode": input_mode,
         "executor_env": dict(executor_env or {}),
         "heartbeat_interval": heartbeat_interval,
+        "telemetry": telemetry_mod.meta_spec(telemetry, tdir),
     }
+    tracer.instant("cluster/start", num_executors=num_executors,
+                   input_mode=str(input_mode),
+                   cluster_id=cluster_meta["id"])
 
     # Launch the start job in the background (reference daemon thread +
     # foreachPartition, TFCluster.py:312-329): SPARK-mode workers run the user
@@ -675,6 +739,8 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
     cluster_info.sort(key=node._sort_key)
     logger.info("cluster nodes: %s",
                 [(n["job_name"], n["task_index"], n["host"]) for n in cluster_info])
+    tracer.instant("cluster/ready", nodes=len(cluster_info),
+                   generation=server.reservations.generation)
 
     # Duplicate-node sanity check (reference TFCluster.py:350-365).
     seen = set()

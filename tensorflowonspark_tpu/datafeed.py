@@ -13,6 +13,7 @@ into a sharded global batch (see :mod:`tensorflowonspark_tpu.parallel.infeed`).
 import logging
 import queue as _queue
 import threading
+import time
 
 import numpy as np
 
@@ -119,6 +120,13 @@ class DataFeed(object):
         # leg publishes this so a throughput number always names the wire
         # format that produced it.
         self.wire_formats = {}
+        # More always-on feed-plane tallies (plain numbers; snapshotted into
+        # heartbeat payloads by the node runtime — see counters_snapshot):
+        # total rows handed to the trainer, and cumulative seconds spent
+        # blocked on an empty input queue (the consumer-starved signal that
+        # tells an input-bound job from a compute-bound one).
+        self.items_consumed = 0
+        self.stall_secs = 0.0
         # Set by interrupt(): unblocks a next_batch blocked on the queue so
         # another thread can take over queue consumption (the queue/ring is
         # single-consumer; see ShardedFeed.terminate).
@@ -199,6 +207,7 @@ class DataFeed(object):
                     # a crash on a malformed item above must leave the queue
                     # un-joined so the feeder's error-poll fires (see ctor).
                     self._ack_chunk()
+        self.items_consumed += count
         self._fault.on_items(count)
         logger.debug("next_batch: returning %d items", count)
         return tensors
@@ -217,12 +226,16 @@ class DataFeed(object):
         """Blocking get that aborts (returning ``_INTERRUPTED``) once
         :meth:`interrupt` fires.  Short-timeout polling, not ``block=True``:
         the proxy's blocking get cannot be cancelled from another thread."""
-        while not self._interrupt.is_set():
-            try:
-                return queue.get(block=True, timeout=0.5)
-            except _queue.Empty:
-                continue
-        return _INTERRUPTED
+        t0 = time.monotonic()
+        try:
+            while not self._interrupt.is_set():
+                try:
+                    return queue.get(block=True, timeout=0.5)
+                except _queue.Empty:
+                    continue
+            return _INTERRUPTED
+        finally:
+            self.stall_secs += time.monotonic() - t0
 
     def interrupt(self):
         """Unblock a concurrent :meth:`next_batch` and make subsequent calls
@@ -371,6 +384,7 @@ class DataFeed(object):
             parts.append(fields)
             count += 1
             queue.task_done()
+        self.items_consumed += count
         self._fault.on_items(count)
         return self._assemble_columns(parts, tuple_rows, dtypes), count
 
@@ -402,6 +416,19 @@ class DataFeed(object):
                 col(f, None if dtypes is None else dtypes[f])
                 for f in range(arity))
         return col(0, dtypes)
+
+    def counters_snapshot(self):
+        """Flat telemetry counters for heartbeat payloads.
+
+        Schema: ``feed_items`` (rows delivered), ``feed_stall_secs`` (time
+        blocked on an empty queue), ``wire_<fmt>`` (chunks per transport —
+        ``wire_colv1``/``wire_pickle``/``wire_queue``).
+        """
+        snap = {"feed_items": self.items_consumed,
+                "feed_stall_secs": round(self.stall_secs, 6)}
+        for fmt, n in list(self.wire_formats.items()):
+            snap["wire_{}".format(fmt)] = n
+        return snap
 
     def should_stop(self):
         """True once end-of-feed was observed (reference ``TFNode.py:153-155``)."""

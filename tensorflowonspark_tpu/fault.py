@@ -265,6 +265,19 @@ class FaultInjector(object):
         self._tasks = 0
         self._chunks = 0
 
+    @staticmethod
+    def _fired(kind, flush=False, **attrs):
+        """Mark an injection firing on the telemetry timeline, so a chaos
+        trace shows WHERE the kill/corruption landed relative to the
+        fence→reclaim→replace spans.  ``flush=True`` for faults that end
+        this process abruptly (SIGKILL never reaches the BYE flush)."""
+        from tensorflowonspark_tpu import telemetry
+
+        tracer = telemetry.get_tracer()
+        tracer.instant("fault/" + kind, **attrs)
+        if flush:
+            tracer.flush()
+
     @classmethod
     def from_env(cls, environ=None):
         """Build from ``TFOS_FAULT_SPEC`` (JSON); :data:`NULL` when unset,
@@ -300,16 +313,19 @@ class FaultInjector(object):
         if kill_at is not None and self._items >= kill_at:
             logger.warning("FaultInjector: killing pid %d after %d items",
                            os.getpid(), self._items)
+            self._fired("kill_after_items", flush=True, items=self._items)
             self._kill_self()
         term_at = self.spec.get("sigterm_at_item")
         if term_at is not None and self._items >= term_at:
             self.spec.pop("sigterm_at_item")  # fire once
             logger.warning("FaultInjector: SIGTERM (preemption) to pid %d "
                            "after %d items", os.getpid(), self._items)
+            self._fired("sigterm_at_item", items=self._items)
             os.kill(os.getpid(), signal.SIGTERM)
         fail_at = self.spec.get("fail_after_items")
         if fail_at is not None and self._items >= fail_at:
             self.spec.pop("fail_after_items")  # fire once
+            self._fired("fail_after_items", items=self._items)
             fail(self.spec.get("message", "injected failure after {} items"
                                .format(self._items)))
 
@@ -321,6 +337,7 @@ class FaultInjector(object):
         if kill_at is not None and self._tasks >= kill_at:
             logger.warning("FaultInjector: killing executor pid %d after %d "
                            "tasks", os.getpid(), self._tasks)
+            self._fired("kill_after_tasks", flush=True, tasks=self._tasks)
             self._kill_self()
 
     def should_drop_heartbeat(self, beats_sent):
@@ -344,6 +361,7 @@ class FaultInjector(object):
         if idx is None or here != idx:
             return data
         logger.warning("FaultInjector: corrupting feed chunk %d", here)
+        self._fired("corrupt_chunk", chunk_index=here)
         corrupted = bytearray(data)
         for i in range(min(16, len(corrupted))):
             corrupted[i] ^= 0xFF
@@ -352,6 +370,7 @@ class FaultInjector(object):
     def maybe_fail(self, where):
         """Generic named failpoint: raise when spec ``fail_at == where``."""
         if self.spec.get("fail_at") == where:
+            self._fired("fail_at", where=where)
             fail(self.spec.get("message",
                                "injected failure at {}".format(where)))
 
@@ -369,6 +388,7 @@ class FaultInjector(object):
         def _notify():
             logger.warning("FaultInjector: preemption notice expired; "
                            "SIGTERM to pid %d", os.getpid())
+            self._fired("preempt_notice", delay_secs=delay)
             os.kill(os.getpid(), signal.SIGTERM)
 
         t = threading.Timer(delay, _notify)
@@ -396,6 +416,7 @@ class FaultInjector(object):
         step_dir = os.path.join(directory, str(max(steps)))
         logger.warning("FaultInjector: corrupting checkpoint step dir %s",
                        step_dir)
+        self._fired("corrupt_checkpoint", step=max(steps))
         for root, _, files in os.walk(step_dir):
             for fname in files:
                 path = os.path.join(root, fname)

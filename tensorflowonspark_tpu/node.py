@@ -32,8 +32,10 @@ import sys
 import threading
 import time
 import traceback
+import weakref
 
-from tensorflowonspark_tpu import fault, manager, marker, reservation, util
+from tensorflowonspark_tpu import (fault, manager, marker, reservation,
+                                   telemetry, util)
 
 logger = logging.getLogger(__name__)
 
@@ -51,6 +53,65 @@ _JAX_JOBS = ("chief", "master", "worker")
 # start task returns — BaseManager shuts its server down when the handle is
 # garbage collected, and the node must outlive the start task in SPARK mode.
 _node_state = {}
+
+# Live DataFeed instances in THIS process (weakrefs; populated by
+# TPUNodeContext.get_data_feed).  The heartbeat metrics provider snapshots
+# them so HBEAT payloads carry feed-plane counters without the feed having
+# to know about telemetry.
+_feeds = []
+
+
+def _register_feed(feed):
+    _feeds.append(weakref.ref(feed))
+
+
+def _node_metrics_provider(mgr, qname="input"):
+    """Build the heartbeat metrics provider for this node's user-fn process.
+
+    Merges (all flat JSON dicts; see telemetry.merge_counters):
+    - shm-ring consumer-side tallies (this process attaches the rings);
+    - every live DataFeed's counters (rows, stall time, wire formats);
+    - feeder-side counters published to the manager KV by feed tasks
+      (they run in a different process — the executor shell);
+    - the input queue's depth high-water mark, sampled per beat.
+
+    Every leg is individually guarded: metrics must never cost a beat.
+    """
+    hwm = {"queue_depth_hwm": 0}
+
+    def _provider():
+        from tensorflowonspark_tpu import shmring
+
+        # Telemetry off: beats stay bare and the driver latches nothing —
+        # tf_status["telemetry"] is part of the opt-in plane, not a default.
+        if not telemetry.get_tracer().enabled:
+            return None
+        parts = [shmring.counters_snapshot()]
+        for ref in list(_feeds):
+            feed = ref()
+            if feed is None:
+                _feeds.remove(ref)
+                continue
+            try:
+                parts.append(feed.counters_snapshot())
+            except Exception:
+                pass
+        try:
+            feeder = mgr.get("feeder_metrics")
+            if isinstance(feeder, dict):
+                parts.append(feeder)
+        except Exception:
+            pass
+        try:
+            depth = mgr.get_queue(qname).qsize()
+            if depth > hwm["queue_depth_hwm"]:
+                hwm["queue_depth_hwm"] = depth
+            parts.append(dict(hwm))
+        except Exception:
+            pass
+        return telemetry.merge_counters(parts)
+
+    return _provider
 
 # ---------------------------------------------------------------------------
 # Preemption drain (SIGTERM): a preempted host must stop feed consumption,
@@ -214,6 +275,12 @@ class TPUNodeContext(object):
         # emergency checkpoint), so feeders unblock instead of pushing into a
         # dying node; drain order is registration order.
         on_preemption(feed.terminate)
+        # Expose the feed's counters to the heartbeat metrics provider (the
+        # real node module of this process, not the closure's copy — see
+        # the _node_state comment in run()).
+        import tensorflowonspark_tpu.node as _node_mod
+
+        _node_mod._register_feed(feed)
         return feed
 
     def absolute_path(self, path):
@@ -317,6 +384,10 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         logger.info("executor_id=%d assigned role %s:%d%s", executor_id,
                     job_name, task_index,
                     " (replacement)" if assignment is not None else "")
+        tracer = telemetry.configure_from_meta(cluster_meta)
+        tracer.instant("node/role_assigned", executor_id=executor_id,
+                       job_name=job_name, task_index=task_index,
+                       replacement=assignment is not None)
 
         # Apply cluster-level env (TPU/XLA perf knobs, device_info.tpu_env)
         # FIRST: libtpu/XLA read these only when the jax client is created,
@@ -348,17 +419,18 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         # must reach directly at shutdown, TFCluster.py:186-192).
         authkey = bytes.fromhex(cluster_meta["authkey"])
         qnames = list(queues)
-        if job_name in ("ps", "evaluator"):
-            if "control" not in qnames:
-                qnames.append("control")
-            mgr = manager.start(authkey, qnames, mode="remote")
-            addr = list(mgr.address)
-            if not addr[0]:
-                addr[0] = util.get_ip_address()
-        else:
-            mgr = manager.start(authkey, qnames, mode="local")
-            addr = mgr.address  # unix socket path (same-host connections only)
-        mgr.set("state", "running")
+        with tracer.span("node/manager_start", executor_id=executor_id):
+            if job_name in ("ps", "evaluator"):
+                if "control" not in qnames:
+                    qnames.append("control")
+                mgr = manager.start(authkey, qnames, mode="remote")
+                addr = list(mgr.address)
+                if not addr[0]:
+                    addr[0] = util.get_ip_address()
+            else:
+                mgr = manager.start(authkey, qnames, mode="local")
+                addr = mgr.address  # unix socket path (same-host connections only)
+            mgr.set("state", "running")
         # Pin the manager handle in the *real* node module of this executor
         # process — not this closure's globals.  The start-task closure is
         # cloudpickled by value, so its reconstructed globals (including any
@@ -392,11 +464,13 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             # Only feed-direction queues get a ring: results travel back as
             # plain Chunks (DataFeed.batch_results), and error/control carry
             # single small messages.
-            for qn in qnames:
-                if qn not in ("error", "control", "output"):
-                    shmring.get_ring(
-                        shmring.ring_name(cluster_meta["id"], executor_id, qn),
-                        create=True)
+            with tracer.span("node/rings", executor_id=executor_id):
+                for qn in qnames:
+                    if qn not in ("error", "control", "output"):
+                        shmring.get_ring(
+                            shmring.ring_name(cluster_meta["id"], executor_id,
+                                              qn),
+                            create=True)
 
         # TensorBoard on the first worker-like node (reference TFSparkNode.py:199-225).
         tb_pid, tb_port = 0, 0
@@ -432,9 +506,12 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             "profiler_port": profiler_port,
             "working_dir": os.getcwd(),
         }
-        client.register(node_meta)
-        cluster_info = client.await_reservations(
-            timeout=cluster_meta.get("reservation_timeout", 600))
+        with tracer.span("node/register", executor_id=executor_id,
+                         job_name=job_name, task_index=task_index):
+            client.register(node_meta)
+        with tracer.span("node/await", executor_id=executor_id):
+            cluster_info = client.await_reservations(
+                timeout=cluster_meta.get("reservation_timeout", 600))
         client.close()
         cluster_info.sort(key=_sort_key)
 
@@ -458,6 +535,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 process_id = i
                 break
         coordinator_address = "{}:{}".format(jax_nodes[0]["host"], jax_nodes[0]["port"])
+        tracer.instant("node/cluster_ready", executor_id=executor_id,
+                       num_processes=num_processes, process_id=process_id)
 
         ctx = TPUNodeContext(
             executor_id, job_name, task_index, cluster_info,
@@ -489,16 +568,24 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             # BYE so they are not miscounted as deaths.
             hb = reservation.HeartbeatSender(
                 cluster_meta["server_addr"], executor_id,
-                heartbeat_interval).start()
+                heartbeat_interval,
+                metrics_provider=_node_metrics_provider(context.mgr)).start()
             # Forked children inherit the parent's preemption registrations;
             # start from a clean slate, then install the SIGTERM drain in the
             # process that actually runs the user fn.
             _reset_preemption()
             _install_sigterm_drain()
+            # SIGUSR1 -> flight record (this forked child owns its main
+            # thread, so the handler installs; no-op when telemetry is off).
+            telemetry.install_sigusr1()
             fault.from_env().arm_preempt_notice()
+            tracer = telemetry.get_tracer()
             reason = None
             try:
-                wrapper_fn(args, context)
+                with tracer.span("node/user_fn", executor_id=executor_id,
+                                 job_name=context.job_name,
+                                 task_index=context.task_index):
+                    wrapper_fn(args, context)
                 reason = "done"
             except Exception:
                 try:
@@ -516,6 +603,10 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 if preempted():
                     reason = "preempted"
                 hb.stop(reason=reason)
+                # Crash-safe flush point: runs on clean completion, on user
+                # exceptions, AND on the SIGTERM drain's SystemExit — the
+                # trace must survive everything short of SIGKILL.
+                tracer.flush()
 
         if job_name in ("ps", "evaluator") or background:
             # Run the user fn in a child process; ps/evaluator then park this
@@ -528,6 +619,10 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             # Publish the user-fn pid so feeders can fast-fail on a consumer
             # that died instead of burning the whole feed_timeout.
             mgr.set("node_pid", p.pid)
+            # The start task returns now (SPARK mode frees the slot for feed
+            # jobs): flush the bring-up spans recorded in THIS process — the
+            # forked child writes its own trace file.
+            tracer.flush()
             if job_name in ("ps", "evaluator"):
                 ctrl = mgr.get_queue("control")
                 errq = mgr.get_queue("error")
@@ -553,13 +648,17 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             mgr.set("node_pid", os.getpid())
             hb = reservation.HeartbeatSender(
                 cluster_meta["server_addr"], executor_id,
-                heartbeat_interval).start()
+                heartbeat_interval,
+                metrics_provider=_node_metrics_provider(mgr)).start()
             _reset_preemption()
             _install_sigterm_drain()
+            telemetry.install_sigusr1()
             fault.from_env().arm_preempt_notice()
             reason = None
             try:
-                wrapper_fn(tf_args, ctx)
+                with tracer.span("node/user_fn", executor_id=executor_id,
+                                 job_name=job_name, task_index=task_index):
+                    wrapper_fn(tf_args, ctx)
                 reason = "done"
             except Exception:
                 errq.put(traceback.format_exc())
@@ -569,6 +668,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                     reason = "preempted"
                 hb.stop(reason=reason)
                 mgr.set("state", "finished")
+                tracer.flush()
 
     return _mapfn
 
@@ -625,6 +725,7 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
     def _train(iterator):
         host = util.get_ip_address()
         executor_id = util.read_executor_id()
+        tracer = telemetry.configure_from_meta(cluster_meta)
         mgr = _get_manager(cluster_info, host, executor_id)
         queue = mgr.get_queue(qname)
         state = mgr.get("state")
@@ -644,21 +745,31 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
             _check_consumer_alive(mgr, executor_id, "before feeding")
             putter = _ChunkPutter(queue, cluster_meta, executor_id, qname,
                                   feed_timeout, cache=(num_epochs > 1))
-            count = _feed_blocks(iterator, putter.put, chunk_size)
-            for _ in range(num_epochs - 1):
-                if mgr.get("state") in ("terminating", "stopped"):
-                    break
-                count += putter.reput_cached()
-            # Wait for the consumer to drain the queue, surfacing user-code
-            # errors and enforcing feed_timeout (reference TFSparkNode.py:407-418).
-            # The deadline scales with epochs: executor-side replay drains
-            # ALL epochs inside this one task, where the reference's
-            # per-epoch partition tasks each got their own timeout — a
-            # fixed deadline would spuriously kill healthy multi-epoch runs
-            # on the in-queue (no-shm-ring) path.
-            _join_with_error_check(mgr, queue,
-                                   feed_timeout * max(num_epochs, 1),
-                                   "feeding", executor_id=executor_id)
+            try:
+                with tracer.span("feed/partition", executor_id=executor_id,
+                                 qname=qname):
+                    count = _feed_blocks(iterator, putter.put, chunk_size)
+                    for _ in range(num_epochs - 1):
+                        if mgr.get("state") in ("terminating", "stopped"):
+                            break
+                        count += putter.reput_cached()
+                    _publish_feeder_metrics(mgr, putter)
+                    # Wait for the consumer to drain the queue, surfacing
+                    # user-code errors and enforcing feed_timeout (reference
+                    # TFSparkNode.py:407-418).  The deadline scales with
+                    # epochs: executor-side replay drains ALL epochs inside
+                    # this one task, where the reference's per-epoch
+                    # partition tasks each got their own timeout — a fixed
+                    # deadline would spuriously kill healthy multi-epoch
+                    # runs on the in-queue (no-shm-ring) path.
+                    _join_with_error_check(mgr, queue,
+                                           feed_timeout * max(num_epochs, 1),
+                                           "feeding",
+                                           executor_id=executor_id)
+            finally:
+                # The feeder's trace must survive a failed join too — the
+                # chaos timeline needs the feed span that the kill cut short.
+                tracer.flush()
             logger.info("fed %d items to %s queue", count, qname)
         # If the consumer began terminating while we fed, ask the driver to
         # stop scheduling feed partitions (reference TFSparkNode.py:422-434).
@@ -669,6 +780,23 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
         return [count]
 
     return _train
+
+
+def _publish_feeder_metrics(mgr, putter):
+    """Accumulate this feed task's counters into the node's manager KV
+    (``feeder_metrics``), where the consumer-side heartbeat provider picks
+    them up.  Feed tasks are serialized per executor, so read-modify-write
+    is race-free; any failure (dead manager mid-chaos) is swallowed —
+    metrics never outrank the feed itself."""
+    if not telemetry.get_tracer().enabled:
+        return
+    try:
+        prev = mgr.get("feeder_metrics")
+        mgr.set("feeder_metrics", telemetry.merge_counters(
+            [prev if isinstance(prev, dict) else {},
+             putter.counters_delta()]))
+    except Exception as e:
+        logger.debug("feeder metrics publish failed: %s", e)
 
 
 def _feed_blocks(iterator, put, chunk_size):
@@ -710,6 +838,12 @@ class _ChunkPutter(object):
         self._queue = queue
         self._feed_timeout = feed_timeout
         self._cache = [] if cache else None
+        # Feeder-side telemetry tallies (always on; plain ints — see the
+        # shmring.Ring counters for the rationale).  Published per feed task
+        # to the node's manager KV so the consumer-side heartbeat can carry
+        # them (the feeder runs in a different process than the user fn).
+        self.items = 0
+        self.bytes = 0
         # Chaos hook: corrupt_chunk_index flips bytes of the Nth serialized
         # chunk on the ring path (consumer-side unpickle/desync failure).
         self._injector = fault.from_env()
@@ -728,6 +862,21 @@ class _ChunkPutter(object):
         if shmring.available():
             self._ring = shmring.get_ring(
                 shmring.ring_name(cluster_meta["id"], executor_id, qname))
+        # Ring tallies are process-cumulative (executor processes host many
+        # feed tasks); remember the baseline so counters_delta() reports
+        # only THIS task's work and the KV accumulation never double counts.
+        self._ring_base = ((self._ring.writes, self._ring.writevs)
+                           if self._ring is not None else (0, 0))
+
+    def counters_delta(self):
+        """This feed task's contribution, as flat telemetry counters."""
+        snap = {"feeder_items": self.items, "feeder_bytes": self.bytes}
+        if self._ring is not None:
+            snap["feeder_ring_writes"] = self._ring.writes - self._ring_base[0]
+            snap["feeder_ring_writevs"] = (self._ring.writevs
+                                           - self._ring_base[1])
+            snap["ring_occupancy_hwm"] = int(self._ring.occupancy_hwm)
+        return snap
 
     def put(self, block):
         chunk = marker.pack_columnar(block)
@@ -735,6 +884,7 @@ class _ChunkPutter(object):
         if chunk is None:
             chunk = marker.Chunk(block)
         data = self._send(chunk, n, data=None)
+        self.items += n
         if self._cache is not None:
             # When the pickled ring path was taken, the bytes alone suffice
             # for replay (holding the chunk too would double the partition's
@@ -759,6 +909,7 @@ class _ChunkPutter(object):
                 chunk = pickle.loads(data)
             self._send(chunk, n, data)
             total += n
+        self.items += total
         return total
 
     def _send_bytes(self, data, n):
@@ -766,6 +917,7 @@ class _ChunkPutter(object):
         if self._ring is not None and self._ring.put_bytes(
                 data, timeout_secs=self._feed_timeout):
             self._queue.put(marker.ShmChunk(self._ring.name, n), block=True)
+            self.bytes += len(data)
             return True
         return False
 
@@ -786,6 +938,8 @@ class _ChunkPutter(object):
                     self._queue.put(
                         marker.ShmChunk(self._ring.name, n,
                                         fmt=wire.WIRE_COLV1), block=True)
+                    self.bytes += sum(
+                        getattr(p, "nbytes", None) or len(p) for p in parts)
                     return None
                 # non-framable columns or an oversized record: pickled path
             if data is None:
@@ -796,6 +950,7 @@ class _ChunkPutter(object):
             if self._ring.put_bytes(payload, timeout_secs=self._feed_timeout):
                 self._queue.put(marker.ShmChunk(self._ring.name, n),
                                 block=True)
+                self.bytes += len(payload)
                 return data
         self._queue.put(chunk, block=True)
         return None
@@ -912,19 +1067,27 @@ def inference(cluster_info, cluster_meta, qname_in="input", qname_out="output",
     def _inference(iterator):
         host = util.get_ip_address()
         executor_id = util.read_executor_id()
+        tracer = telemetry.configure_from_meta(cluster_meta)
         mgr = _get_manager(cluster_info, host, executor_id)
         queue_in = mgr.get_queue(qname_in)
 
         putter = _ChunkPutter(queue_in, cluster_meta, executor_id, qname_in,
                               feed_timeout)
-        count = _feed_blocks(iterator, putter.put, chunk_size)
-        # Signal end-of-partition so DataFeed can align result batches
-        # (reference TFSparkNode.py:469, marker.py).
-        queue_in.put(marker.EndPartition(), block=True)
-        if count == 0:
-            return []
-        _join_with_error_check(mgr, queue_in, feed_timeout,
-                               "inference feeding", executor_id=executor_id)
+        try:
+            with tracer.span("feed/partition", executor_id=executor_id,
+                             qname=qname_in, mode="inference"):
+                count = _feed_blocks(iterator, putter.put, chunk_size)
+                _publish_feeder_metrics(mgr, putter)
+                # Signal end-of-partition so DataFeed can align result batches
+                # (reference TFSparkNode.py:469, marker.py).
+                queue_in.put(marker.EndPartition(), block=True)
+                if count == 0:
+                    return []
+                _join_with_error_check(mgr, queue_in, feed_timeout,
+                                       "inference feeding",
+                                       executor_id=executor_id)
+        finally:
+            tracer.flush()
 
         # Collect exactly `count` results: the 1:1 input/output contract
         # (reference TFSparkNode.py:491-500, TFNode.py:160-162).

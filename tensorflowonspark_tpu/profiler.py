@@ -113,3 +113,13 @@ class StepProfiler(object):
             jax.profiler.stop_trace()
             self._active = False
             logger.info("profiler trace stopped at step %d", self.step)
+
+    # Context-manager form: an exception between start/stop would otherwise
+    # leak an active jax.profiler trace and poison the next capture attempt
+    # (start_trace raises if one is already running).
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.stop()
+        return False

@@ -32,6 +32,8 @@ import struct
 import threading
 import time
 
+from tensorflowonspark_tpu import telemetry
+
 logger = logging.getLogger(__name__)
 
 # Env overrides for multi-homed / NAT'd drivers (reference reservation.py:23-24).
@@ -91,7 +93,8 @@ class Reservations(object):
                     "extra registration {}".format(
                         len(self._reservations), self.required, key[1:]))
             self._reservations.append(meta)
-            if self._claim_released_slot(meta):
+            replacement = self._claim_released_slot(meta)
+            if replacement:
                 self.generation += 1
                 logger.info(
                     "replacement %s admitted into released slot %s:%s; "
@@ -99,6 +102,16 @@ class Reservations(object):
                     meta.get("job_name", "?") if isinstance(meta, dict) else "?",
                     meta.get("task_index", "?") if isinstance(meta, dict) else "?",
                     self.generation)
+            telemetry.get_tracer().instant(
+                "reservation/admission",
+                executor_id=(meta.get("executor_id")
+                             if isinstance(meta, dict) else None),
+                job_name=(meta.get("job_name")
+                          if isinstance(meta, dict) else None),
+                task_index=(meta.get("task_index")
+                            if isinstance(meta, dict) else None),
+                replacement=bool(replacement),
+                generation=self.generation)
             if self.done():
                 self._lock.notify_all()
 
@@ -134,6 +147,12 @@ class Reservations(object):
                         "released slot %s:%s of fenced executor %s for "
                         "replacement admission", meta.get("job_name", "?"),
                         meta.get("task_index", "?"), executor_id)
+                    telemetry.get_tracer().instant(
+                        "reservation/release",
+                        executor_id=executor_id,
+                        job_name=meta.get("job_name"),
+                        task_index=meta.get("task_index"),
+                        generation=self.generation)
                     return meta
         return None
 
@@ -242,6 +261,11 @@ class Server(MessageSocket):
         self._dead = {}  # executor_id -> human-readable death description
         self._released_ids = set()  # dead executors whose slot was released
         self._byes = {}  # executor_id -> BYE reason (when one was given)
+        # Latest HBEAT-carried telemetry counter snapshot per executor
+        # (flat JSON dicts; see telemetry.merge_counters for the schema).
+        # A BYE keeps the snapshot: the final aggregate must still cover
+        # nodes that finished cleanly before the driver latched it.
+        self._node_metrics = {}
 
     # -- liveness ---------------------------------------------------------
 
@@ -252,6 +276,14 @@ class Server(MessageSocket):
     def bye_reasons(self):
         """Snapshot of clean-deregistration reasons, keyed by executor id."""
         return dict(self._byes)
+
+    def metrics_snapshot(self):
+        """Cluster metrics view from the HBEAT payloads: per-node snapshots
+        plus the merged aggregate (sums, ``_hwm`` keys by max)."""
+        nodes = {str(ex): dict(snap)
+                 for ex, snap in list(self._node_metrics.items())}
+        return {"nodes": nodes,
+                "aggregate": telemetry.merge_counters(nodes.values())}
 
     def release_slot(self, executor_id):
         """Release the fenced executor's roster slot for replacement
@@ -271,11 +303,15 @@ class Server(MessageSocket):
                 and meta.get("executor_id") is not None:
             self._beats[meta["executor_id"]] = (time.monotonic(), meta)
 
-    def _beat(self, executor_id):
+    def _beat(self, executor_id, metrics=None):
         """Record a heartbeat; False if the node was already declared dead
-        (the sender is fenced: a zombie must not resurrect silently)."""
+        (the sender is fenced: a zombie must not resurrect silently).
+        ``metrics`` is an optional piggybacked counter snapshot (flat JSON
+        dict); the latest per executor is kept for :meth:`metrics_snapshot`."""
         if executor_id in self._dead:
             return False
+        if isinstance(metrics, dict) and metrics:
+            self._node_metrics[executor_id] = metrics
         if executor_id in self._beats:
             self._beats[executor_id] = (
                 time.monotonic(), self._beats[executor_id][1])
@@ -306,6 +342,12 @@ class Server(MessageSocket):
                 self._dead[executor_id] = desc
                 del self._beats[executor_id]
                 newly_dead.append((meta, age))
+                telemetry.get_tracer().instant(
+                    "reservation/fence", executor_id=executor_id,
+                    job_name=meta.get("job_name"),
+                    task_index=meta.get("task_index"),
+                    age_secs=round(age, 3),
+                    generation=self.reservations.generation)
         if newly_dead:
             # Fire on_dead BEFORE waking waiters: the callback may release
             # the dead node's slot for replacement (cluster.run), and a
@@ -353,32 +395,51 @@ class Server(MessageSocket):
         membership change).
         """
         deadline = time.time() + timeout
-        while (not self.reservations.done()
-               or (generation is not None
-                   and self.reservations.generation < generation)):
-            if status and "error" in status:
-                raise Exception(
-                    "Cluster startup failed on an executor: {}".format(status["error"])
-                )
-            unrecovered = self._unrecovered_dead()
-            if unrecovered:
-                raise Exception(
-                    "Cluster startup failed: node(s) died during bring-up: "
-                    "{}".format("; ".join(unrecovered)))
-            if time.time() > deadline:
-                raise Exception(
-                    "Timed out waiting for cluster reservations after {}s: "
-                    "{} of {} nodes registered. Check executor logs; common causes "
-                    "are insufficient executors or firewalled driver ports.".format(
-                        timeout,
-                        self.reservations.required - self.reservations.remaining(),
-                        self.reservations.required,
+        # Hang flight recorder: a bring-up stalled for half its budget (or
+        # 60 s, whichever is sooner) dumps all-thread stacks + roster state
+        # once, so a silent AWAIT hang leaves an attributable report even if
+        # nobody gets to send SIGUSR1 before the timeout fires.
+        watch = telemetry.StallWatch(
+            "await_reservations stalled",
+            deadline=min(timeout * 0.5, 60.0) if timeout else 60.0,
+            extra_fn=lambda: {
+                "registered": (self.reservations.required
+                               - self.reservations.remaining()),
+                "required": self.reservations.required,
+                "generation": self.reservations.generation,
+                "dead_nodes": self.dead_nodes(),
+                "released_slots": [
+                    list(s) for s in self.reservations.released_slots()],
+            })
+        with telemetry.get_tracer().span(
+                "reservation/await", required=self.reservations.required):
+            while (not self.reservations.done()
+                   or (generation is not None
+                       and self.reservations.generation < generation)):
+                if status and "error" in status:
+                    raise Exception(
+                        "Cluster startup failed on an executor: {}".format(status["error"])
                     )
+                unrecovered = self._unrecovered_dead()
+                if unrecovered:
+                    raise Exception(
+                        "Cluster startup failed: node(s) died during bring-up: "
+                        "{}".format("; ".join(unrecovered)))
+                if time.time() > deadline:
+                    raise Exception(
+                        "Timed out waiting for cluster reservations after {}s: "
+                        "{} of {} nodes registered. Check executor logs; common causes "
+                        "are insufficient executors or firewalled driver ports.".format(
+                            timeout,
+                            self.reservations.required - self.reservations.remaining(),
+                            self.reservations.required,
+                        )
+                    )
+                self.reservations.wait(timeout=1.0)
+                watch.poke()
+                logger.info(
+                    "waiting for %d reservations", self.reservations.remaining()
                 )
-            self.reservations.wait(timeout=1.0)
-            logger.info(
-                "waiting for %d reservations", self.reservations.remaining()
-            )
         logger.info("all %d reservations completed", self.reservations.required)
         return self.reservations.get()
 
@@ -409,13 +470,23 @@ class Server(MessageSocket):
                 self.send(sock, {"type": "ERR", "error": str(e)})
                 return True
             self._watch(meta)
+            telemetry.get_tracer().instant(
+                "reservation/register",
+                executor_id=(meta.get("executor_id")
+                             if isinstance(meta, dict) else None),
+                job_name=(meta.get("job_name")
+                          if isinstance(meta, dict) else None),
+                task_index=(meta.get("task_index")
+                            if isinstance(meta, dict) else None),
+                remaining=self.reservations.remaining())
             self.send(sock, {"type": "OK"})
         elif mtype == "HBEAT":
-            executor_id = (msg.get("data") or {}).get("executor_id")
+            data = msg.get("data") or {}
+            executor_id = data.get("executor_id")
             if executor_id is None:
                 self.send(sock, {"type": "ERR",
                                  "error": "HBEAT without executor_id"})
-            elif self._beat(executor_id):
+            elif self._beat(executor_id, metrics=data.get("metrics")):
                 self.send(sock, {"type": "OK"})
             else:
                 self.send(sock, {"type": "ERR",
@@ -425,7 +496,13 @@ class Server(MessageSocket):
             data = msg.get("data") or {}
             executor_id = data.get("executor_id")
             if executor_id is not None:
+                metrics = data.get("metrics")
+                if isinstance(metrics, dict) and metrics:
+                    self._node_metrics[executor_id] = metrics
                 self._forget(executor_id, reason=data.get("reason"))
+                telemetry.get_tracer().instant(
+                    "reservation/bye", executor_id=executor_id,
+                    reason=data.get("reason"))
             self.send(sock, {"type": "OK"})
         elif mtype == "QUERY":
             self.send(sock, {"type": "QUERY", "done": self.reservations.done()})
@@ -596,22 +673,30 @@ class Client(MessageSocket):
             raise Exception("registration rejected: {}".format(
                 resp.get("error", resp)))
 
-    def heartbeat(self, executor_id):
+    def heartbeat(self, executor_id, metrics=None):
         """Send one liveness beat; returns False if the server fenced this
         node (declared dead — the caller should stop beating and may choose
-        to self-terminate rather than run as a zombie)."""
-        resp = self._request({"type": "HBEAT",
-                              "data": {"executor_id": executor_id}})
+        to self-terminate rather than run as a zombie).  ``metrics`` is an
+        optional flat JSON dict of telemetry counters piggybacked on the
+        beat (messages are JSON-only; see module docstring)."""
+        data = {"executor_id": executor_id}
+        if metrics:
+            data["metrics"] = metrics
+        resp = self._request({"type": "HBEAT", "data": data})
         return resp.get("type") == "OK"
 
-    def goodbye(self, executor_id, reason=None):
+    def goodbye(self, executor_id, reason=None, metrics=None):
         """Clean liveness deregistration: this node is finishing on purpose,
         so the monitor must not read its silence as a death.  ``reason``
         (``done`` / ``preempted``) lets the driver tell clean completion
-        from a preemption drain in ``tf_status``."""
+        from a preemption drain in ``tf_status``.  ``metrics`` carries the
+        node's final telemetry counter snapshot — a node that finishes
+        between beats would otherwise never report."""
         data = {"executor_id": executor_id}
         if reason is not None:
             data["reason"] = reason
+        if metrics:
+            data["metrics"] = metrics
         self._request({"type": "BYE", "data": data})
 
     def get_reservations(self):
@@ -688,10 +773,15 @@ class HeartbeatSender(object):
     A clean ``stop()`` sends ``BYE`` so planned exits aren't counted as deaths.
     """
 
-    def __init__(self, server_addr, executor_id, interval):
+    def __init__(self, server_addr, executor_id, interval,
+                 metrics_provider=None):
+        """``metrics_provider``: optional zero-arg callable returning a flat
+        JSON-serializable counter dict to piggyback on each beat (errors are
+        swallowed — metrics must never cost a liveness beat)."""
         self.server_addr = tuple(server_addr)
         self.executor_id = executor_id
         self.interval = interval
+        self.metrics_provider = metrics_provider
         self.fenced = False
         self._stop = threading.Event()
         self._client = None
@@ -725,8 +815,15 @@ class HeartbeatSender(object):
                 logger.warning("fault injection: dropping heartbeat %d",
                                self._beats_sent)
                 continue
+            metrics = None
+            if self.metrics_provider is not None:
+                try:
+                    metrics = self.metrics_provider()
+                except Exception as e:
+                    logger.debug("heartbeat metrics provider failed: %s", e)
             try:
-                if not self._ensure_client().heartbeat(self.executor_id):
+                if not self._ensure_client().heartbeat(self.executor_id,
+                                                       metrics=metrics):
                     logger.error(
                         "executor %s fenced by the liveness monitor; "
                         "stopping heartbeats", self.executor_id)
@@ -745,8 +842,15 @@ class HeartbeatSender(object):
         if self._thread.is_alive():
             self._thread.join(timeout=max(self.interval * 2, 5.0))
         if goodbye and not self.fenced and self.interval:
+            metrics = None
+            if self.metrics_provider is not None:
+                try:
+                    metrics = self.metrics_provider()
+                except Exception:
+                    pass
             try:
-                self._ensure_client().goodbye(self.executor_id, reason=reason)
+                self._ensure_client().goodbye(self.executor_id, reason=reason,
+                                              metrics=metrics)
             except Exception as e:
                 logger.warning("BYE failed (%s); the driver may log a "
                                "spurious dead node", e)

@@ -95,6 +95,16 @@ class Ring(object):
     def __init__(self, handle, name):
         self._h = handle
         self.name = name
+        # Plain-int telemetry tallies, always on: a few integer ops per
+        # record is noise next to the memcpy, so the hot path needs no
+        # enabled-check (telemetry merely *reads* these at heartbeat
+        # cadence — see counters_snapshot()).
+        self.writes = 0
+        self.writevs = 0
+        self.reads = 0
+        self.peeks = 0
+        self.consumes = 0
+        self.occupancy_hwm = 0
 
     @classmethod
     def create_or_attach(cls, name, capacity=DEFAULT_CAPACITY):
@@ -125,6 +135,10 @@ class Ring(object):
         rc = _lib().shmring_write(self._h, data, len(data),
                                   int(timeout_secs * 1000))
         if rc == 0:
+            self.writes += 1
+            fill = _lib().shmring_fill(self._h)
+            if fill > self.occupancy_hwm:
+                self.occupancy_hwm = fill
             return True
         if rc == -3:
             return False
@@ -158,6 +172,10 @@ class Ring(object):
                                    int(timeout_secs * 1000))
         del keep
         if rc == 0:
+            self.writevs += 1
+            fill = _lib().shmring_fill(self._h)
+            if fill > self.occupancy_hwm:
+                self.occupancy_hwm = fill
             return True
         if rc == -3:
             return False
@@ -187,6 +205,7 @@ class Ring(object):
             raise RuntimeError(
                 "shm ring {} short read: next_len promised {} bytes, pop "
                 "returned {}".format(self.name, n, got))
+        self.reads += 1
         return buf.raw
 
     def peek(self, timeout_secs=600):
@@ -205,12 +224,14 @@ class Ring(object):
             raise TimeoutError(
                 "shm ring {} read timed out after {}s".format(
                     self.name, timeout_secs))
+        self.peeks += 1
         return memoryview((ctypes.c_ubyte * int(n)).from_address(ptr.value))
 
     def consume(self):
         """Two-phase zero-copy read, phase 2: release the record exposed by
         the last :meth:`peek` (advances the tail; the peeked view is dead)."""
         _lib().shmring_consume(self._h)
+        self.consumes += 1
 
     def put(self, obj, timeout_secs=600):
         """Pickle + write; returns False when the object can never fit."""
@@ -259,6 +280,27 @@ _created = set()  # names this process created: unlinked at exit as a safety
 def _atexit_unlink():
     for name in list(_created):
         unlink(name)
+
+
+def counters_snapshot():
+    """Flat telemetry counters over every ring this process has touched.
+
+    Heartbeat-payload schema (sums across rings; ``_hwm`` merges by max
+    downstream — see :func:`telemetry.merge_counters`):
+    ``ring_writes/ring_writevs/ring_reads/ring_peeks/ring_consumes/
+    ring_occupancy_hwm``.
+    """
+    snap = {"ring_writes": 0, "ring_writevs": 0, "ring_reads": 0,
+            "ring_peeks": 0, "ring_consumes": 0, "ring_occupancy_hwm": 0}
+    for ring in list(_rings.values()):
+        snap["ring_writes"] += ring.writes
+        snap["ring_writevs"] += ring.writevs
+        snap["ring_reads"] += ring.reads
+        snap["ring_peeks"] += ring.peeks
+        snap["ring_consumes"] += ring.consumes
+        if ring.occupancy_hwm > snap["ring_occupancy_hwm"]:
+            snap["ring_occupancy_hwm"] = int(ring.occupancy_hwm)
+    return snap
 
 
 def get_ring(name, create=False):

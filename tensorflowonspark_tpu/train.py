@@ -518,7 +518,7 @@ class Trainer(object):
 
 
 def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
-                   max_steps=None, steps_per_call=1):
+                   max_steps=None, steps_per_call=1, profiler=None):
     """Supervised :meth:`Trainer.fit_feed`: restore-latest, train with
     periodic checkpoints, and on a retryable failure back off, re-restore,
     and try again from the last saved step.
@@ -536,13 +536,19 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
         (default policy when None).  Only retryable failures re-enter the
         loop; user-code bugs re-raise immediately.
       max_steps / steps_per_call: forwarded to :meth:`Trainer.fit_feed`.
+      profiler: optional :class:`~tensorflowonspark_tpu.profiler.StepProfiler`;
+        it is stepped once per dispatch and used as a context manager around
+        every attempt, so an exception mid-capture stops the trace instead
+        of leaking it into the retry's capture.
 
     Returns the final fit stats dict.
     """
     from tensorflowonspark_tpu import fault as fault_mod
     from tensorflowonspark_tpu import node as node_mod
+    from tensorflowonspark_tpu import telemetry
 
     policy = retry_policy or fault_mod.RetryPolicy()
+    tracer = telemetry.get_tracer()
 
     def _emergency_save():
         # Preemption drain: land whatever progress exists before the process
@@ -560,17 +566,34 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
     # works; multi-host preemption recovery rides the periodic saves.)
     if ckpt_manager.is_chief:
         node_mod.on_preemption(_emergency_save)
+    def _on_steps(s):
+        ckpt_manager.maybe_save(s, trainer.state)
+        if profiler is not None:
+            profiler.on_step_end()
+
+    def _fit_once():
+        return trainer.fit_feed(feed_factory(), max_steps=max_steps,
+                                steps_per_call=steps_per_call,
+                                on_steps=_on_steps)
+
     try:
         for attempt in range(policy.max_attempts):
-            restored = trainer.restore_latest(ckpt_manager, validate=True)
+            with tracer.span("train/restore", attempt=attempt + 1):
+                restored = trainer.restore_latest(ckpt_manager, validate=True)
             if restored is not None:
                 logger.info("supervised fit: resuming from checkpoint step %d",
                             restored)
             try:
-                stats = trainer.fit_feed(
-                    feed_factory(), max_steps=max_steps,
-                    steps_per_call=steps_per_call,
-                    on_steps=lambda s: ckpt_manager.maybe_save(s, trainer.state))
+                with tracer.span("train/fit_attempt", attempt=attempt + 1,
+                                 restored_step=restored):
+                    if profiler is not None:
+                        # Context-manager form: stop() runs on the exception
+                        # path too, so a failed attempt cannot leak an active
+                        # trace into the next attempt's capture.
+                        with profiler:
+                            stats = _fit_once()
+                    else:
+                        stats = _fit_once()
                 ckpt_manager.maybe_save(int(trainer.state.step), trainer.state,
                                         force=True)
                 ckpt_manager.wait_until_finished()
@@ -584,6 +607,8 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
                     "supervised fit attempt %d/%d failed (%s: %s); restoring "
                     "latest checkpoint and retrying in %.1fs", attempt + 1,
                     policy.max_attempts, type(e).__name__, e, delay)
+                tracer.instant("train/retry", attempt=attempt + 1,
+                               delay_secs=delay, error=repr(e))
                 time.sleep(delay)
         raise AssertionError("unreachable")  # pragma: no cover
     finally:

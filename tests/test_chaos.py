@@ -7,6 +7,7 @@ Every test here runs under the ``chaos`` marker's SIGALRM wall-clock limit
 alarm turns that into a stack-bearing failure instead of a stuck suite.
 """
 
+import glob
 import json
 import os
 import random
@@ -258,6 +259,75 @@ def test_elastic_replacement_full_loop():
         # the killed node never completed
         assert not os.path.exists(
             os.path.join(b.workdir_root, "executor-0", "sum.txt"))
+    finally:
+        b.stop()
+
+
+@pytest.mark.chaos(timeout=240)
+def test_chaos_timeline_reconstructs_kill_fence_reclaim_replace(tmp_path):
+    """Observability flagship: rerun the elastic loop with ``telemetry=True``
+    and reconstruct the WHOLE incident from the trace files alone —
+    injected kill → liveness fence → slot release → replacement admission —
+    with consistent executor/generation attributes and causal ordering.
+    This is what an operator gets when they load a chaos run's telemetry
+    directory into Perfetto."""
+    spec = json.dumps({"kill_after_items": 5})
+    tdir = str(tmp_path / "telemetry")
+    b = backend.LocalBackend(
+        3, env_per_executor=[{fault.FAULT_SPEC_ENV: spec}, None, None])
+    try:
+        c = cluster.run(b, _node_sum_fn, tf_args=[], num_executors=3,
+                        input_mode=InputMode.SPARK,
+                        heartbeat_interval=0.5, heartbeat_misses=2,
+                        telemetry=True, telemetry_dir=tdir)
+        policy = fault.RetryPolicy(max_attempts=5, initial_backoff=1.5,
+                                   multiplier=1.5, jitter=0.3,
+                                   rng=random.Random(13))
+        c.train(backend.partition(range(30), 3), retry_policy=policy)
+        assert c.tf_status.get("replacements"), c.tf_status
+        c.shutdown(grace_secs=1)
+
+        # every process wrote a parseable Chrome trace
+        events = []
+        for path in glob.glob(os.path.join(tdir, "trace-*.json")):
+            with open(path) as f:
+                events.extend(json.load(f)["traceEvents"])
+        by_name = {}
+        for e in events:
+            by_name.setdefault(e["name"], []).append(e)
+
+        # the injected kill itself is on the timeline (the injector flushes
+        # its trace before SIGKILLing the process)
+        (kill,) = by_name["fault/kill_after_items"]
+        assert kill["args"]["items"] >= 5
+
+        # fence -> release -> admission, all naming the same incident
+        (fence,) = by_name["reservation/fence"]
+        assert fence["args"]["executor_id"] == 0
+        (release,) = by_name["reservation/release"]
+        assert release["args"]["executor_id"] == 0
+        assert release["args"]["job_name"] == fence["args"]["job_name"]
+        admissions = [e for e in by_name["reservation/admission"]
+                      if e["args"].get("replacement")]
+        assert len(admissions) == 1, by_name["reservation/admission"]
+        adm = admissions[0]["args"]
+        assert adm["executor_id"] == 3
+        assert (adm["job_name"], adm["task_index"]) == (
+            release["args"]["job_name"], release["args"]["task_index"])
+        # the admission bumped the generation the release was observed at
+        assert adm["generation"] == release["args"]["generation"] + 1
+
+        # causal order on the shared wall-clock timeline
+        assert (kill["ts"] <= fence["ts"] <= release["ts"]
+                <= admissions[0]["ts"])
+
+        # the driver's replacement dispatch and the new node's bring-up are
+        # also present (the "replace" leg of the story)
+        assert by_name.get("cluster/replacement_dispatched")
+        assert by_name.get("backend/provision_replacement")
+        replacement_regs = [e for e in by_name["node/register"]
+                            if e["args"].get("executor_id") == 3]
+        assert replacement_regs, by_name["node/register"]
     finally:
         b.stop()
 

@@ -158,6 +158,58 @@ if shmring.available():
 else:
     print("shmring unavailable")
 
+# H. disaggregated data service: local FileFeed vs ServiceFeed with 1 and 2
+# feed workers on localhost (docs/DATA_SERVICE.md) — same synthetic MNIST
+# row shape, identical reader everywhere, so the deltas are transport +
+# worker-count scaling, not reader differences.
+from tensorflowonspark_tpu import data as data_mod
+from tensorflowonspark_tpu import dataservice
+
+H_SPLITS, H_SPLIT_ROWS = 16, 1024
+
+def synth_reader(path):
+    """Row reader keyed on a synthetic split path (no disk: the leg measures
+    the feed planes, not the filesystem)."""
+    base = int(path.rsplit("-", 1)[1]) * H_SPLIT_ROWS
+    for i in range(H_SPLIT_ROWS):
+        j = (base + i) % ROWS
+        yield (images[j], int(labels[j]))
+
+h_paths = ["synth-{}".format(i) for i in range(H_SPLITS)]
+
+def drain_columnar(feed):
+    t0 = time.perf_counter()
+    n = 0
+    while not feed.should_stop():
+        _, cnt = feed.next_batch_arrays(BATCH)
+        n += cnt
+    return time.perf_counter() - t0, n
+
+ff = data_mod.FileFeed(h_paths, row_reader=synth_reader, reader_threads=2,
+                       shard=False)
+h_secs, h_n = drain_columnar(ff)
+report("H local FileFeed drain", h_secs, h_n)
+
+for n_workers in (1, 2):
+    disp = dataservice.DispatcherServer(heartbeat_interval=1.0,
+                                        host="127.0.0.1")
+    addr = disp.start()
+    ws = [dataservice.FeedWorker(addr, row_reader=synth_reader,
+                                 worker_id="prof{}-{}".format(n_workers, i))
+          .start() for i in range(n_workers)]
+    sf = dataservice.ServiceFeed(addr, h_paths,
+                                 job_name="prof-{}".format(n_workers),
+                                 mode=dataservice.SHARD_DYNAMIC, prefetch=4,
+                                 timeout=120.0)
+    h_secs, h_n = drain_columnar(sf)
+    report("H%d ServiceFeed (%d worker%s, colv1/TCP)"
+           % (n_workers + 1, n_workers, "s" if n_workers > 1 else ""),
+           h_secs, h_n)
+    sf.terminate()
+    for w in ws:
+        w.stop()
+    disp.stop()
+
 # F. driver pipe ship of a 7500-row partition (multiprocessing Pipe)
 import multiprocessing as mp
 ctx = mp.get_context("spawn")

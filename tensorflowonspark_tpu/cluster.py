@@ -494,7 +494,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         queues=("input", "output", "error"), eval_node=False,
         release_port=True, profiler=False, executor_env=None,
         driver_ps_nodes=False, heartbeat_interval=5.0, heartbeat_misses=3,
-        telemetry=False, telemetry_dir=None):
+        telemetry=False, telemetry_dir=None, data_service=None):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -534,6 +534,11 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
       telemetry_dir: directory for per-process trace/flight files
         (default: ``<log_dir>/telemetry``, or ``./telemetry`` without a
         log_dir).  See docs/OBSERVABILITY.md.
+      data_service: dispatcher address of a disaggregated data service
+        (``"host:port"``, ``(host, port)``, or a ``{"dispatcher": addr}``
+        dict) — executors then read input over the network via
+        ``ctx.get_service_feed(...)`` instead of reading files locally.
+        See docs/DATA_SERVICE.md.
     """
     if hasattr(cluster_backend, "parallelize"):  # raw SparkContext
         cluster_backend = backend_mod.SparkBackend(cluster_backend)
@@ -665,6 +670,16 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                                 on_dead=_on_dead, on_bye=_on_bye)
     server_addr = server.start()
 
+    # Normalize the data-service spec to {"dispatcher": [host, port]} for
+    # the JSON hop to executors (ctx.get_service_feed consumes it).
+    if data_service is not None:
+        addr = (data_service.get("dispatcher")
+                if isinstance(data_service, dict) else data_service)
+        if isinstance(addr, str):
+            host, _, port = addr.rpartition(":")
+            addr = (host, int(port))
+        data_service = {"dispatcher": [addr[0], int(addr[1])]}
+
     cluster_meta = {
         "id": "{:x}".format(random.getrandbits(64)),
         "cluster_template": cluster_template,
@@ -677,6 +692,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         "executor_env": dict(executor_env or {}),
         "heartbeat_interval": heartbeat_interval,
         "telemetry": telemetry_mod.meta_spec(telemetry, tdir),
+        "data_service": data_service,
     }
     tracer.instant("cluster/start", num_executors=num_executors,
                    input_mode=str(input_mode),

@@ -90,6 +90,26 @@ def tfrecord_rows(path, binary_features=(), schema=None):
         yield dfutil.from_example(rec, inferred, as_numpy=True)
 
 
+def jsonl_rows(path):
+    """Generator of rows from a JSON-lines file (one JSON value per line).
+
+    Objects become dict rows (columnar by key), top-level arrays become
+    TUPLE rows (a ``[x, y]`` line is a 2-field row — the row shape the
+    columnar contract treats as fields; a list row would be a single vector
+    value instead, see :mod:`~tensorflowonspark_tpu.columnar`), and scalars
+    become single-value rows.  The zero-dependency reader for data-service
+    workers and tests."""
+    import json
+
+    with fsio.open_file(path, "rb") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            yield tuple(row) if isinstance(row, list) else row
+
+
 def packed_lm_reader(seq_len, tokens_key="tokens", eos_id=None):
     """FileFeed row reader factory for LM training from TFRecord shards:
     concatenates each record's int64 ``tokens_key`` feature (appending

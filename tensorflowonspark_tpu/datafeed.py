@@ -36,6 +36,42 @@ def _rows_to_fields(rows):
     return columnar.rows_to_fields(rows, strict=True)
 
 
+def assemble_columns(parts, tuple_rows, dtypes, input_tensors=None):
+    """Concatenate per-part field slices into final per-field arrays and
+    shape the result per the input_mapping contract (shared by
+    :class:`DataFeed` and the data-service
+    :class:`~tensorflowonspark_tpu.dataservice.ServiceFeed`).
+
+    ``parts`` is a list of per-field tuples of array slices; the result is a
+    per-tensor dict when ``input_tensors`` is given, a tuple of field arrays
+    for tuple rows, else a single array."""
+    if not parts:
+        if input_tensors is None:
+            return np.empty((0,))
+        return {t: np.empty((0,)) for t in input_tensors}
+    arity = len(parts[0])
+
+    def col(f, dtype):
+        arrs = [p[f] for p in parts]
+        out = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
+        return out if dtype is None else np.asarray(out, dtype=dtype)
+
+    if input_tensors is not None:
+        if arity != len(input_tensors):
+            raise ValueError(
+                "input_mapping names {} tensors but feed rows have {} "
+                "fields".format(len(input_tensors), arity))
+        return {
+            t: col(f, None if dtypes is None else dtypes.get(t))
+            for f, t in enumerate(input_tensors)
+        }
+    if tuple_rows:
+        return tuple(
+            col(f, None if dtypes is None else dtypes[f])
+            for f in range(arity))
+    return col(0, dtypes)
+
+
 def absolute_path(ctx, path):
     """Convert a user path to an absolute path on shared storage.
 
@@ -389,33 +425,8 @@ class DataFeed(object):
         return self._assemble_columns(parts, tuple_rows, dtypes), count
 
     def _assemble_columns(self, parts, tuple_rows, dtypes):
-        """Concatenate per-part field slices into final per-field arrays and
-        shape the result per the input_mapping contract."""
-        if not parts:
-            if self.input_tensors is None:
-                return np.empty((0,))
-            return {t: np.empty((0,)) for t in self.input_tensors}
-        arity = len(parts[0])
-
-        def col(f, dtype):
-            arrs = [p[f] for p in parts]
-            out = arrs[0] if len(arrs) == 1 else np.concatenate(arrs)
-            return out if dtype is None else np.asarray(out, dtype=dtype)
-
-        if self.input_tensors is not None:
-            if arity != len(self.input_tensors):
-                raise ValueError(
-                    "input_mapping names {} tensors but feed rows have {} "
-                    "fields".format(len(self.input_tensors), arity))
-            return {
-                t: col(f, None if dtypes is None else dtypes.get(t))
-                for f, t in enumerate(self.input_tensors)
-            }
-        if tuple_rows:
-            return tuple(
-                col(f, None if dtypes is None else dtypes[f])
-                for f in range(arity))
-        return col(0, dtypes)
+        return assemble_columns(parts, tuple_rows, dtypes,
+                                self.input_tensors)
 
     def counters_snapshot(self):
         """Flat telemetry counters for heartbeat payloads.

@@ -1,0 +1,1327 @@
+"""Disaggregated data service: dispatcher + remote feed workers over TCP.
+
+Per-host input pipelines cap accelerator utilization once a model is
+input-bound — the tf.data service (arXiv:2210.14826) shows the fix is to
+move input processing onto a horizontally-scalable fleet of feed workers
+and keep only a thin client on the accelerator hosts.  This module
+composes the framework's existing planes into exactly that shape:
+
+- :class:`DispatcherServer` — control plane.  Registers workers, owns the
+  split ledger for each dataset job (sharding modes :data:`SHARD_OFF` /
+  :data:`SHARD_STATIC` / :data:`SHARD_DYNAMIC`), monitors worker liveness
+  with the same heartbeat/fencing semantics as the rendezvous
+  (:mod:`~tensorflowonspark_tpu.reservation`), and reassigns the splits of
+  dead workers so every split is visited **exactly once per epoch**
+  (tf.data's visitation guarantee, arXiv:2101.12127 §3.3).
+- :class:`FeedWorker` — data plane producer.  Wraps a
+  :class:`~tensorflowonspark_tpu.data.FileFeed` /
+  :class:`~tensorflowonspark_tpu.data.ProcessPoolFeed` reader per split and
+  streams row blocks to consumers as length-prefixed colv1 frames
+  (:mod:`~tensorflowonspark_tpu.wire`) with pickle fallback for
+  object/ragged columns — the same framability rules as the shm-ring
+  feeder (``node._ChunkPutter``).
+- :class:`ServiceFeed` — data plane consumer.  ``DataFeed``-compatible
+  ``next_batch`` / ``next_batch_arrays`` surface, so ``ShardedFeed`` and
+  ``train.fit_supervised`` consume it unchanged; receiver threads
+  double-buffer network frames ahead of consumption and tally
+  ``wire_formats`` + ``dataservice_*`` telemetry counters that ride node
+  heartbeats into ``TPUCluster.metrics_snapshot()``.
+
+Exactly-once protocol (STATIC / DYNAMIC): a split travels as
+``split_begin`` → data frames → ``split_end`` on one worker→consumer
+stream.  The consumer buffers the split's frames and **commits** only on
+``split_end``: first it reports ``DONE`` to the dispatcher (marking the
+split visited in the ledger), then it publishes the buffered chunks to
+its batch queue.  A worker death mid-split drops the connection before
+``split_end`` — the consumer discards the partial buffer, the dispatcher
+fences the worker and re-pools its uncompleted splits (bound to the same
+consumer), and a surviving worker re-streams them.  A consumer-side
+``(epoch, split)`` dedupe set makes the race between a fenced-but-alive
+zombie worker and the reassigned replacement harmless: whichever
+``split_end`` lands first wins, the other is discarded.
+
+Wire protocol: the dispatcher speaks the length-prefixed-JSON
+``MessageSocket`` idiom of :mod:`~tensorflowonspark_tpu.reservation`
+(``HBEAT``/``BYE`` are byte-compatible, so workers reuse
+``HeartbeatSender`` verbatim).  Worker→consumer data streams use a 5-byte
+``>IB`` prefix (payload length + kind): kind 0 JSON control, kind 1 a
+colv1 frame, kind 2 pickled rows.
+"""
+
+import json
+import logging
+import pickle
+import queue as _queue
+import select
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from tensorflowonspark_tpu import fault, marker, telemetry, wire
+from tensorflowonspark_tpu.reservation import (
+    Client, HeartbeatSender, MessageSocket)
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "SHARD_OFF", "SHARD_STATIC", "SHARD_DYNAMIC", "DispatchError",
+    "DispatcherServer", "DispatcherClient", "FeedWorker", "ServiceFeed",
+]
+
+#: No coordination: every worker→consumer stream delivers the FULL dataset
+#: (``num_epochs`` times).  No visitation guarantee — with W workers a
+#: consumer sees W copies per epoch.  The mode for sample-with-replacement
+#: training where duplication is acceptable (tf.data service ShardingPolicy
+#: OFF).
+SHARD_OFF = "off"
+#: Splits are owned by workers (round-robin over the worker roster frozen
+#: at first assignment); a dead worker's remaining splits transfer to
+#: survivors.  Exactly-once per epoch.
+SHARD_STATIC = "static"
+#: First-come-first-served: any worker pops the next unvisited split.
+#: Self-balancing under heterogeneous workers.  Exactly-once per epoch.
+SHARD_DYNAMIC = "dynamic"
+
+_MODES = (SHARD_OFF, SHARD_STATIC, SHARD_DYNAMIC)
+
+# Data-stream framing: 4-byte big-endian payload length + 1-byte kind.
+_DHEADER = struct.Struct(">IB")
+_K_JSON = 0     # UTF-8 JSON control message
+_K_COLV1 = 1    # one wire.py colv1 frame (zero-copy decode on receipt)
+_K_PICKLE = 2   # pickled row list (object/ragged fallback)
+
+_SENTINEL = object()     # internal end-of-feed marker on the chunk queue
+_INTERRUPTED = object()  # internal next_batch abort marker
+
+
+class DispatchError(RuntimeError):
+    """The dispatcher answered ``ERR`` (unknown job, fenced worker, ...)."""
+
+
+# ---------------------------------------------------------------------------
+# Data-stream framing helpers
+# ---------------------------------------------------------------------------
+
+def _recv_exact(sock, n):
+    # Returns a bytearray, not bytes: a final bytes(buf) copy of every
+    # ~800 KB chunk payload caps the consumer's aggregate ingest around
+    # 750 MB/s on loopback; skipping it nearly triples the framing ceiling.
+    # Callers treat the buffer as immutable (frombuffer views pin it).
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if k == 0:
+            raise EOFError("connection closed mid-frame")
+        got += k
+    return buf
+
+
+def _recv_frame(sock):
+    """One ``(kind, payload)`` data frame; raises EOFError on a closed peer."""
+    length, kind = _DHEADER.unpack(_recv_exact(sock, _DHEADER.size))
+    return kind, _recv_exact(sock, length)
+
+
+# Below this, header+payload are sent as one concatenated buffer so small
+# control frames never sit behind Nagle/delayed-ACK interactions with a
+# previous partial segment; at or above it the payload copy costs more than
+# the second sendall (TCP_NODELAY is set on every data socket anyway).
+_SEND_COPY_MAX = 64 * 1024
+
+
+def _send_frame(sock, kind, payload):
+    header = _DHEADER.pack(len(payload), kind)
+    if len(payload) < _SEND_COPY_MAX:
+        sock.sendall(header + payload)
+    else:
+        sock.sendall(header)
+        sock.sendall(payload)
+
+
+def _send_json(sock, obj):
+    _send_frame(sock, _K_JSON, json.dumps(obj).encode("utf-8"))
+
+
+def _addr_tuple(addr):
+    """Normalize ``(host, port)`` / ``[host, port]`` / ``"host:port"``."""
+    if isinstance(addr, str):
+        host, _, port = addr.rpartition(":")
+        return (host, int(port))
+    return (addr[0], int(addr[1]))
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher: split ledger
+# ---------------------------------------------------------------------------
+
+class _Job(object):
+    """Per-job split ledger (dispatcher-internal; all access serialized by
+    the dispatcher's lock).
+
+    Splits are file paths, identified by index.  Per epoch each split moves
+    ``unassigned`` → ``assigned`` (bound to the ``(worker, consumer)`` that
+    is streaming it) → ``completed`` (the consumer's ``DONE`` after a
+    committed ``split_end``).  A worker death moves its assigned splits to
+    ``pending[consumer]`` — still bound to the SAME consumer, so the
+    consumer-side dedupe set covers every path a duplicate could take."""
+
+    def __init__(self, name, splits, num_epochs, mode):
+        self.name = name
+        self.splits = list(splits)
+        self.num_epochs = int(num_epochs)
+        self.mode = mode
+        self.epoch = 0
+        self.done = not self.splits or self.num_epochs <= 0
+        self.reassigned = 0        # splits re-pooled from dead workers (total)
+        self.static_owner = None   # split idx -> worker_id (STATIC, lazy)
+        self.off_served = set()    # (worker, consumer) streams served (OFF)
+        self._init_epoch()
+
+    def _init_epoch(self):
+        self.unassigned = list(range(len(self.splits)))
+        self.assigned = {}   # split idx -> (worker_id, consumer_id)
+        self.completed = set()
+        self.pending = {}    # consumer_id -> [split idx] (death reassignments)
+
+    def spec(self):
+        return {"splits": self.splits, "num_epochs": self.num_epochs,
+                "mode": self.mode}
+
+    # -- assignment --------------------------------------------------------
+
+    def _ensure_static_owners(self, live_workers):
+        if self.static_owner is None:
+            owners = sorted(live_workers)
+            self.static_owner = {
+                i: owners[i % len(owners)] if owners else None
+                for i in range(len(self.splits))}
+
+    def next_splits(self, worker_id, consumer_id, live_workers):
+        """One TASK answer: ``{"splits": [[idx, path]], "epoch": e}``, or
+        ``{"wait": True}`` (epoch still completing / nothing for this
+        worker yet), or ``{"done": True}`` (job exhausted)."""
+        if self.mode == SHARD_OFF:
+            key = (worker_id, consumer_id)
+            if self.done or key in self.off_served:
+                return {"done": True}
+            self.off_served.add(key)
+            return {"splits": [[i, p] for i, p in enumerate(self.splits)],
+                    "epoch": 0, "epochs": self.num_epochs}
+        if self.done:
+            return {"done": True}
+        # 1. death-reassigned splits bound to this consumer (any worker may
+        #    serve them — the original owner is gone)
+        pend = self.pending.get(consumer_id)
+        while pend:
+            s = pend.pop(0)
+            if s in self.completed or s in self.assigned:
+                continue  # the zombie's copy already landed / re-pooled twice
+            self.assigned[s] = (worker_id, consumer_id)
+            return {"splits": [[s, self.splits[s]]], "epoch": self.epoch}
+        # 2. fresh splits
+        if self.mode == SHARD_STATIC:
+            self._ensure_static_owners(live_workers)
+            for i, s in enumerate(self.unassigned):
+                owner = self.static_owner.get(s)
+                if owner is None or owner == worker_id:
+                    self.unassigned.pop(i)
+                    self.assigned[s] = (worker_id, consumer_id)
+                    return {"splits": [[s, self.splits[s]]],
+                            "epoch": self.epoch}
+        elif self.unassigned:
+            s = self.unassigned.pop(0)
+            self.assigned[s] = (worker_id, consumer_id)
+            return {"splits": [[s, self.splits[s]]], "epoch": self.epoch}
+        return {"wait": True}
+
+    def complete(self, epoch, split, consumer_id):
+        """Consumer's ``DONE`` for a committed split; idempotent."""
+        if self.mode == SHARD_OFF or self.done or epoch != self.epoch:
+            return {"ok": True, "stale": True}
+        if split in self.completed:
+            return {"ok": True, "duplicate": True}
+        self.completed.add(split)
+        self.assigned.pop(split, None)
+        for pend in self.pending.values():
+            if split in pend:
+                pend.remove(split)
+        if len(self.completed) == len(self.splits):
+            self.epoch += 1
+            if self.epoch >= self.num_epochs:
+                self.done = True
+            else:
+                self._init_epoch()
+        return {"ok": True}
+
+    def release_worker(self, worker_id, live_workers):
+        """Re-pool a dead (or departing) worker's uncompleted splits; STATIC
+        ownership of its unstarted splits transfers to survivors."""
+        moved = 0
+        for s, (w, consumer) in list(self.assigned.items()):
+            if w == worker_id:
+                del self.assigned[s]
+                self.pending.setdefault(consumer, []).append(s)
+                moved += 1
+        if self.mode == SHARD_STATIC and self.static_owner:
+            survivors = sorted(w for w in live_workers if w != worker_id)
+            n = 0
+            for s, owner in list(self.static_owner.items()):
+                if owner == worker_id:
+                    self.static_owner[s] = (
+                        survivors[n % len(survivors)] if survivors else None)
+                    n += 1
+        self.reassigned += moved
+        return moved
+
+    def status(self):
+        return {"job": self.name, "mode": self.mode, "epoch": self.epoch,
+                "num_epochs": self.num_epochs,
+                "num_splits": len(self.splits), "done": self.done,
+                "completed": len(self.completed),
+                "assigned": len(self.assigned),
+                "pending": sum(len(v) for v in self.pending.values()),
+                "reassigned": self.reassigned}
+
+
+# ---------------------------------------------------------------------------
+# DispatcherServer
+# ---------------------------------------------------------------------------
+
+class DispatcherServer(MessageSocket):
+    """Data-service control plane: worker registry + split ledgers.
+
+    Single listener thread multiplexing all connections with ``select``
+    (the :class:`~tensorflowonspark_tpu.reservation.Server` idiom); worker
+    liveness uses the same fencing semantics — a worker past
+    ``interval × misses`` of heartbeat silence is declared dead, its beats
+    are rejected from then on (``HeartbeatSender`` stops itself on the
+    fence answer), and its uncompleted splits are re-pooled.
+
+    Message types (length-prefixed JSON): ``WREG`` (worker registration),
+    ``HBEAT``/``BYE`` (byte-compatible with the rendezvous, so workers
+    reuse ``HeartbeatSender``), ``JOB`` (idempotent job creation),
+    ``WORKERS`` (live roster for consumers), ``TASK`` (split request),
+    ``DONE`` (consumer's split-visited report), ``STATUS``, ``STOP``.
+    """
+
+    def __init__(self, heartbeat_interval=1.0, heartbeat_misses=3,
+                 host=None):
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_misses = heartbeat_misses
+        self._host = host
+        self._jobs = {}      # name -> _Job
+        self._workers = {}   # worker_id -> {"worker_id","host","port"}
+        self._beats = {}     # worker_id -> last beat (monotonic)
+        self._dead = {}      # worker_id -> death description
+        self._lock = threading.RLock()
+        self._stopping = False
+        self._socket = None
+        self._thread = None
+
+    # -- snapshots (any thread) -------------------------------------------
+
+    def workers(self):
+        """Live worker roster: ``{worker_id: {worker_id, host, port}}``."""
+        with self._lock:
+            return {w: dict(meta) for w, meta in self._workers.items()}
+
+    def dead_workers(self):
+        """Fenced-worker descriptions keyed by worker id."""
+        with self._lock:
+            return dict(self._dead)
+
+    def job_status(self, name):
+        """Ledger snapshot for one job (``None`` if unknown)."""
+        with self._lock:
+            job = self._jobs.get(name)
+            return job.status() if job is not None else None
+
+    # -- ledger mutation (listener thread, under lock) ---------------------
+
+    def _register_worker(self, meta):
+        worker_id = meta.get("worker_id")
+        if not worker_id or "host" not in meta or "port" not in meta:
+            return "worker registration needs worker_id, host, port"
+        if worker_id in self._dead:
+            return ("worker {} was fenced by the liveness monitor; a "
+                    "replacement must register with a fresh identity"
+                    .format(worker_id))
+        if worker_id in self._workers:
+            return "duplicate worker id {}".format(worker_id)
+        self._workers[worker_id] = {"worker_id": worker_id,
+                                    "host": meta["host"],
+                                    "port": int(meta["port"])}
+        self._beats[worker_id] = time.monotonic()
+        telemetry.get_tracer().instant(
+            "dataservice/worker_register", worker_id=worker_id,
+            workers=len(self._workers))
+        return None
+
+    def _release_worker(self, worker_id, why):
+        """Drop a worker from the roster and re-pool its splits."""
+        self._workers.pop(worker_id, None)
+        self._beats.pop(worker_id, None)
+        live = list(self._workers)
+        moved = 0
+        for job in self._jobs.values():
+            moved += job.release_worker(worker_id, live)
+        if moved:
+            logger.warning("dataservice: re-pooled %d split(s) from worker "
+                           "%s (%s)", moved, worker_id, why)
+            telemetry.get_tracer().instant(
+                "dataservice/split_reassign", worker_id=worker_id,
+                splits=moved, why=why)
+
+    def _check_liveness(self):
+        if not self.heartbeat_interval:
+            return
+        deadline = self.heartbeat_interval * self.heartbeat_misses
+        now = time.monotonic()
+        with self._lock:
+            for worker_id, last in list(self._beats.items()):
+                age = now - last
+                if age > deadline and worker_id in self._workers:
+                    desc = ("feed worker {} missed {} heartbeats (last beat "
+                            "{:.1f}s ago, interval {:.1f}s)").format(
+                                worker_id, self.heartbeat_misses, age,
+                                self.heartbeat_interval)
+                    logger.error("dataservice liveness: %s", desc)
+                    self._dead[worker_id] = desc
+                    telemetry.get_tracer().instant(
+                        "dataservice/worker_dead", worker_id=worker_id,
+                        age_secs=round(age, 3))
+                    self._release_worker(worker_id, "dead")
+
+    def _handle_message(self, sock, msg):
+        mtype = msg.get("type")
+        data = msg.get("data") or {}
+        with self._lock:
+            if mtype == "WREG":
+                err = self._register_worker(data)
+                if err:
+                    logger.warning("rejecting worker registration: %s", err)
+                    self.send(sock, {"type": "ERR", "error": err})
+                else:
+                    self.send(sock, {"type": "OK"})
+            elif mtype == "HBEAT":
+                worker_id = data.get("executor_id")
+                if worker_id in self._dead:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "marked dead by the liveness "
+                                              "monitor"})
+                else:
+                    # beats from ids we never saw register are tracked too
+                    # (mirrors reservation.Server._beat)
+                    if worker_id is not None:
+                        self._beats[worker_id] = time.monotonic()
+                    self.send(sock, {"type": "OK"})
+            elif mtype == "BYE":
+                worker_id = data.get("executor_id")
+                if worker_id is not None and worker_id in self._workers:
+                    self._release_worker(worker_id, "bye")
+                self.send(sock, {"type": "OK"})
+            elif mtype == "JOB":
+                name = data.get("name")
+                job = self._jobs.get(name)
+                spec = {"splits": list(data.get("splits") or []),
+                        "num_epochs": int(data.get("num_epochs", 1)),
+                        "mode": data.get("mode", SHARD_DYNAMIC)}
+                if spec["mode"] not in _MODES:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "unknown sharding mode {!r}"
+                                              .format(spec["mode"])})
+                elif job is None:
+                    self._jobs[name] = _Job(name, spec["splits"],
+                                            spec["num_epochs"], spec["mode"])
+                    telemetry.get_tracer().instant(
+                        "dataservice/job", job=name, mode=spec["mode"],
+                        splits=len(spec["splits"]),
+                        num_epochs=spec["num_epochs"])
+                    self.send(sock, {"type": "OK", "created": True})
+                elif job.spec() == spec:
+                    self.send(sock, {"type": "OK", "created": False})
+                else:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "job {!r} already exists with "
+                                              "a different spec".format(name)})
+            elif mtype == "WORKERS":
+                self.send(sock, {"type": "WORKERS",
+                                 "data": sorted(self._workers.values(),
+                                                key=lambda m: m["worker_id"])})
+            elif mtype == "TASK":
+                job = self._jobs.get(data.get("job"))
+                worker_id = data.get("worker_id")
+                if job is None:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "unknown job {!r}"
+                                              .format(data.get("job"))})
+                elif worker_id in self._dead:
+                    # a fenced-but-alive zombie must stop serving: its
+                    # splits were re-pooled, streaming on would only feed
+                    # the consumer-side dedupe
+                    self.send(sock, {"type": "ERR",
+                                     "error": "marked dead by the liveness "
+                                              "monitor"})
+                else:
+                    ans = job.next_splits(worker_id, data.get("consumer_id"),
+                                          list(self._workers))
+                    ans["type"] = "TASK"
+                    self.send(sock, ans)
+            elif mtype == "DONE":
+                job = self._jobs.get(data.get("job"))
+                if job is None:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "unknown job {!r}"
+                                              .format(data.get("job"))})
+                else:
+                    ans = job.complete(int(data.get("epoch", 0)),
+                                       int(data.get("split", -1)),
+                                       data.get("consumer_id"))
+                    if job.done:
+                        telemetry.get_tracer().instant(
+                            "dataservice/job_done", job=job.name)
+                    ans["type"] = "OK"
+                    self.send(sock, ans)
+            elif mtype == "STATUS":
+                job = self._jobs.get(data.get("job"))
+                if job is None:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "unknown job {!r}"
+                                              .format(data.get("job"))})
+                else:
+                    status = job.status()
+                    status["workers"] = len(self._workers)
+                    status["dead_workers"] = len(self._dead)
+                    self.send(sock, {"type": "STATUS", "data": status})
+            elif mtype == "STOP":
+                self.send(sock, {"type": "OK"})
+                self._stopping = True
+            else:
+                logger.warning("dataservice: ignoring unknown message %r",
+                               mtype)
+                self.send(sock, {"type": "ERR",
+                                 "error": "unknown message type"})
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Bind, spawn the daemon listener thread, return ``(host, port)``."""
+        self._socket = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._socket.bind(("", 0))
+        self._socket.listen(64)
+        host = self._host
+        if not host:
+            from tensorflowonspark_tpu import util
+
+            host = util.get_ip_address()
+        addr = (host, self._socket.getsockname()[1])
+
+        def _listen():
+            conns = [self._socket]
+            while not self._stopping:
+                try:
+                    readable, _, _ = select.select(conns, [], [], 0.1)
+                except (OSError, ValueError):
+                    break  # listen socket closed by stop()
+                for sock in readable:
+                    if sock is self._socket:
+                        try:
+                            client, _ = sock.accept()
+                        except OSError:
+                            continue
+                        conns.append(client)
+                        continue
+                    try:
+                        keep = self._handle_message(sock, self.receive(sock))
+                    except (EOFError, OSError, ValueError):
+                        keep = False
+                    if not keep:
+                        conns.remove(sock)
+                        sock.close()
+                self._check_liveness()
+            for sock in conns:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        self._thread = threading.Thread(target=_listen,
+                                        name="dataservice-dispatcher",
+                                        daemon=True)
+        self._thread.start()
+        logger.info("dataservice dispatcher listening on %s:%d",
+                    addr[0], addr[1])
+        return addr
+
+    def stop(self):
+        self._stopping = True
+        if self._socket is not None:
+            try:
+                self._socket.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# DispatcherClient
+# ---------------------------------------------------------------------------
+
+class DispatcherClient(Client):
+    """Typed request helpers over the rendezvous ``Client`` transport
+    (connect retries, finite request timeouts, ``HBEAT``/``BYE`` reuse)."""
+
+    def _call(self, mtype, data=None):
+        resp = self._request({"type": mtype, "data": data or {}})
+        if resp.get("type") == "ERR":
+            raise DispatchError(resp.get("error", "dispatcher error"))
+        return resp
+
+    def register_worker(self, worker_id, host, port):
+        self._call("WREG", {"worker_id": worker_id, "host": host,
+                            "port": int(port)})
+
+    def register_job(self, name, splits, num_epochs=1, mode=SHARD_DYNAMIC):
+        """Create (or idempotently re-assert) a dataset job."""
+        return self._call("JOB", {"name": name, "splits": list(splits),
+                                  "num_epochs": num_epochs,
+                                  "mode": mode}).get("created", False)
+
+    def workers(self):
+        """Live worker roster as a list of ``{worker_id, host, port}``."""
+        return self._call("WORKERS").get("data") or []
+
+    def request_task(self, job, worker_id, consumer_id):
+        return self._call("TASK", {"job": job, "worker_id": worker_id,
+                                   "consumer_id": consumer_id})
+
+    def done_split(self, job, epoch, split, consumer_id):
+        return self._call("DONE", {"job": job, "epoch": epoch,
+                                   "split": split,
+                                   "consumer_id": consumer_id})
+
+    def status(self, job):
+        return self._call("STATUS", {"job": job}).get("data") or {}
+
+
+def _default_retry_policy():
+    # dial/registration races at service bring-up are connection-shaped and
+    # resolve in well under a second on localhost
+    return fault.RetryPolicy(max_attempts=4, initial_backoff=0.1,
+                             max_backoff=1.0)
+
+
+# ---------------------------------------------------------------------------
+# FeedWorker
+# ---------------------------------------------------------------------------
+
+class FeedWorker(object):
+    """One data-service worker: reads splits, streams framed blocks.
+
+    Listens on ``(host, port)`` for consumer streams; each accepted stream
+    sends a JSON hello ``{"job", "consumer"}`` and then receives splits as
+    the worker wins them from the dispatcher (``TASK`` poll per stream).
+    Rows come from a per-split :class:`~tensorflowonspark_tpu.data.FileFeed`
+    (or :class:`~tensorflowonspark_tpu.data.ProcessPoolFeed` with
+    ``use_process_pool=True``) built over ``row_reader``; blocks go out as
+    colv1 frames when framable, pickled rows otherwise — exactly the
+    ``node._ChunkPutter`` fallback rules, including the
+    ``TFOS_WIRE_FORMAT=pickle`` A/B knob.
+
+    Liveness: a ``HeartbeatSender`` pointed at the dispatcher (the
+    ``HBEAT``/``BYE`` wire shapes are shared with the rendezvous).  Chaos:
+    ``fault.FaultInjector`` hooks fire per block (``kill_after_items``)
+    and per finished split (``kill_after_splits``).
+    """
+
+    def __init__(self, dispatcher_addr, row_reader=None, host="127.0.0.1",
+                 port=0, worker_id=None, heartbeat_interval=1.0,
+                 use_process_pool=False, num_procs=2, retry_policy=None):
+        self.dispatcher_addr = _addr_tuple(dispatcher_addr)
+        self.row_reader = row_reader
+        self.host = host
+        self.port = port
+        self.worker_id = worker_id or "worker-{}-{}".format(
+            socket.gethostname(), id(self) & 0xffffff)
+        self.heartbeat_interval = heartbeat_interval
+        self.use_process_pool = use_process_pool
+        self.num_procs = num_procs
+        self.retry_policy = retry_policy or _default_retry_policy()
+        # telemetry/test tallies (plain ints; read cross-thread)
+        self.splits_streamed = 0
+        self.items_streamed = 0
+        self.bytes_streamed = 0
+        self._framed = wire.enabled()
+        self._injector = fault.from_env()
+        self._stop = threading.Event()
+        self._socket = None
+        self._heartbeat = None
+        self._accept_thread = None
+        self._conns = set()
+        self._conns_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Bind the data port, register with the dispatcher, start
+        heartbeating and accepting consumer streams.  Returns self."""
+        self._socket = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._socket.bind((self.host, self.port))
+        self._socket.listen(16)
+        self.port = self._socket.getsockname()[1]
+
+        def _register():
+            client = DispatcherClient(self.dispatcher_addr)
+            try:
+                client.register_worker(self.worker_id, self.host, self.port)
+            finally:
+                client.close()
+
+        self.retry_policy.call(_register)
+        self._heartbeat = HeartbeatSender(
+            self.dispatcher_addr, self.worker_id,
+            self.heartbeat_interval).start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop,
+            name="feedworker-accept-{}".format(self.worker_id), daemon=True)
+        self._accept_thread.start()
+        logger.info("feed worker %s serving on %s:%d", self.worker_id,
+                    self.host, self.port)
+        return self
+
+    def stop(self, abrupt=False):
+        """Shut down.  ``abrupt=True`` models a crash for tests: streams and
+        heartbeats just stop (no ``BYE``), so the dispatcher must fence this
+        worker by heartbeat timeout and re-pool its splits."""
+        self._stop.set()
+        if self._socket is not None:
+            try:
+                self._socket.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._heartbeat is not None:
+            self._heartbeat.stop(goodbye=not abrupt)
+
+    # -- stream serving ----------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                readable, _, _ = select.select([self._socket], [], [], 0.2)
+            except (OSError, ValueError):
+                return
+            if not readable:
+                continue
+            try:
+                conn, _ = self._socket.accept()
+            except OSError:
+                return
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(target=self._serve_stream, args=(conn,),
+                             name="feedworker-stream-{}".format(
+                                 self.worker_id),
+                             daemon=True).start()
+
+    def _serve_stream(self, conn):
+        client = None
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            kind, payload = _recv_frame(conn)
+            if kind != _K_JSON:
+                raise DispatchError("stream hello must be a JSON frame")
+            hello = json.loads(payload)
+            job, consumer = hello["job"], hello["consumer"]
+            client = DispatcherClient(self.dispatcher_addr)
+            while not self._stop.is_set():
+                task = client.request_task(job, self.worker_id, consumer)
+                if task.get("wait"):
+                    time.sleep(0.05)
+                    continue
+                if task.get("done"):
+                    _send_json(conn, {"type": "stream_end"})
+                    break
+                for _ in range(int(task.get("epochs", 1))):
+                    for split, path in task["splits"]:
+                        self._stream_split(conn, split,
+                                           int(task.get("epoch", 0)), path)
+        except (EOFError, OSError) as e:
+            logger.info("feed worker %s: stream closed (%s)",
+                        self.worker_id, e)
+        except DispatchError as e:
+            # fenced mid-serve, or the job vanished: end the stream; the
+            # consumer's partial-split discard handles the rest
+            logger.warning("feed worker %s: dispatcher refused (%s)",
+                           self.worker_id, e)
+        except Exception:
+            if not self._stop.is_set():
+                logger.exception("feed worker %s: stream failed",
+                                 self.worker_id)
+        finally:
+            if client is not None:
+                client.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _make_feed(self, path):
+        from tensorflowonspark_tpu import data
+
+        if self.use_process_pool:
+            return data.ProcessPoolFeed([path], row_reader=self.row_reader,
+                                        num_procs=self.num_procs, shard=False)
+        return data.FileFeed([path], row_reader=self.row_reader,
+                             reader_threads=1, shard=False)
+
+    def _stream_split(self, conn, split, epoch, path):
+        tracer = telemetry.get_tracer()
+        with tracer.span("dataservice/split_stream", split=split,
+                         epoch=epoch, worker_id=self.worker_id):
+            _send_json(conn, {"type": "split_begin", "split": split,
+                              "epoch": epoch})
+            feed = self._make_feed(path)
+            feed._ensure_started()
+            try:
+                while not self._stop.is_set():
+                    block = feed._next_rows()
+                    if block is None:
+                        break
+                    self._send_block(conn, block)
+            finally:
+                feed.terminate()
+            _send_json(conn, {"type": "split_end", "split": split,
+                              "epoch": epoch})
+        self.splits_streamed += 1
+        self._injector.on_split()
+
+    def _send_block(self, conn, block):
+        payload = None
+        if self._framed:
+            chunk = marker.pack_columnar(block)
+            if chunk is not None:
+                payload = wire.frame_chunk_bytes(chunk)
+        if payload is not None:
+            _send_frame(conn, _K_COLV1, payload)
+        else:
+            payload = pickle.dumps(block, protocol=pickle.HIGHEST_PROTOCOL)
+            _send_frame(conn, _K_PICKLE, payload)
+        self.items_streamed += len(block)
+        self.bytes_streamed += len(payload)
+        self._injector.on_items(len(block))
+
+
+# ---------------------------------------------------------------------------
+# ServiceFeed
+# ---------------------------------------------------------------------------
+
+class ServiceFeed(object):
+    """Consumer-side client: a ``DataFeed``-compatible feed whose rows come
+    from the data service instead of local files.
+
+    Drop-in for the ``DataFeed`` duck type: ``next_batch`` /
+    ``next_batch_arrays`` / ``should_stop`` / ``interrupt`` / ``terminate``
+    / ``wire_formats`` / ``counters_snapshot`` — so
+    ``parallel.infeed.ShardedFeed`` and ``train.fit_supervised`` consume it
+    unchanged (``TPUNodeContext.get_service_feed`` is the node-side
+    constructor).
+
+    One receiver thread per worker stream decodes frames ahead of
+    consumption into a bounded chunk queue — the client-side double
+    buffering: the network receive of chunk N+1 overlaps the trainer's
+    consumption of chunk N, ``prefetch`` chunks deep.  A maintainer thread
+    tracks the dispatcher's worker roster, dialing workers as they appear
+    (late joiners included) and detecting job completion.
+
+    Args:
+      dispatcher_addr: ``(host, port)`` or ``"host:port"``.
+      files: split paths (the job's dataset; every consumer of a job must
+        pass the same list — job registration is idempotent).
+      job_name: dataset job identity shared by all its consumers.
+      mode: :data:`SHARD_OFF` / :data:`SHARD_STATIC` / :data:`SHARD_DYNAMIC`.
+      num_epochs: passes over the splits (epoch boundaries are invisible,
+        like ``FileFeed``).
+      consumer_id: this consumer's identity in the split ledger (defaults
+        to ``host-pid``).
+      input_mapping: as ``DataFeed`` — ``{column: tensor}``; ``next_batch``
+        then returns per-tensor dicts (tuple rows only).
+      prefetch: chunk-queue depth (≥2: double buffering).
+      min_workers: wait for this many workers before binding (OFF mode
+        binds its worker set once, see :data:`SHARD_OFF`).
+      timeout: seconds without progress (no connect, no commit) before the
+        feed raises — turns a dead service into an error, not a hang.
+    """
+
+    def __init__(self, dispatcher_addr, files, job_name="default",
+                 mode=SHARD_DYNAMIC, num_epochs=1, consumer_id=None,
+                 input_mapping=None, prefetch=2, min_workers=1,
+                 retry_policy=None, timeout=60.0):
+        if mode not in _MODES:
+            raise ValueError("unknown sharding mode {!r} (one of {})"
+                             .format(mode, _MODES))
+        self.dispatcher_addr = _addr_tuple(dispatcher_addr)
+        self.files = list(files)
+        self.job_name = job_name
+        self.mode = mode
+        self.num_epochs = num_epochs
+        self.consumer_id = consumer_id or "{}-{}".format(
+            socket.gethostname(), id(self) & 0xffffff)
+        self.input_tensors = (
+            [tensor for _, tensor in sorted(input_mapping.items())]
+            if input_mapping is not None else None)
+        self.min_workers = min_workers
+        self.retry_policy = retry_policy or _default_retry_policy()
+        self.timeout = timeout
+        # DataFeed-compatible observability surface
+        self.wire_formats = {}
+        self.items_consumed = 0
+        self.stall_secs = 0.0
+        self.splits_committed = 0
+        self.split_dupes = 0
+        self.splits_discarded = 0
+        self.bytes_received = 0
+        self._fault = fault.from_env()
+        self._chunks = _queue.Queue(maxsize=max(2, prefetch))
+        self._buffer = []
+        self._buffer_idx = 0
+        self._interrupt = threading.Event()
+        self._stop = threading.Event()
+        self._done = False          # sentinel consumed (consumer thread only)
+        self._sentinel_sent = False
+        self._errors = _queue.Queue()
+        self._committed = set()     # (epoch, split) commit dedupe
+        self._commit_lock = threading.Lock()
+        self._started = False
+        self._streams = {}          # worker_id -> receiver thread
+        self._stream_socks = {}     # worker_id -> socket
+        self._stream_lock = threading.Lock()
+        self._dial_failures = {}
+        self._last_progress = time.monotonic()
+        self._maintainer = None
+
+    # -- service wiring ----------------------------------------------------
+
+    def _ensure_started(self):
+        if self._started:
+            return
+        self._started = True
+        client = self.retry_policy.call(
+            lambda: DispatcherClient(self.dispatcher_addr))
+        client.register_job(self.job_name, self.files,
+                            num_epochs=self.num_epochs, mode=self.mode)
+        self._maintainer = threading.Thread(
+            target=self._maintain, args=(client,),
+            name="servicefeed-maintain-{}".format(self.consumer_id),
+            daemon=True)
+        self._maintainer.start()
+
+    def _maintain(self, client):
+        """Roster tracking + completion detection (daemon thread)."""
+        off_bound = None  # OFF mode: the worker set frozen at binding time
+        try:
+            while not self._stop.is_set():
+                try:
+                    roster = {m["worker_id"]: m for m in client.workers()}
+                except (DispatchError, OSError, EOFError, TimeoutError) as e:
+                    logger.warning("servicefeed: worker listing failed (%s)",
+                                   e)
+                    roster = {}
+                if self.mode == SHARD_OFF:
+                    if off_bound is None:
+                        if len(roster) >= self.min_workers:
+                            off_bound = set(roster)
+                    dial = {} if off_bound is None else {
+                        w: m for w, m in roster.items() if w in off_bound}
+                else:
+                    dial = roster
+                with self._stream_lock:
+                    for worker_id, meta in dial.items():
+                        if (worker_id not in self._streams
+                                and self._dial_failures.get(worker_id, 0) < 3):
+                            t = threading.Thread(
+                                target=self._receive_stream,
+                                args=(worker_id, meta),
+                                name="servicefeed-rx-{}".format(worker_id),
+                                daemon=True)
+                            self._streams[worker_id] = t
+                            t.start()
+                # completion: ledger modes ask the dispatcher; OFF is purely
+                # per-stream (all bound streams finished)
+                if self.mode == SHARD_OFF:
+                    with self._stream_lock:
+                        threads = list(self._streams.values())
+                    if (off_bound is not None and threads
+                            and all(not t.is_alive() for t in threads)):
+                        break
+                else:
+                    try:
+                        if client.status(self.job_name).get("done"):
+                            break
+                    except (DispatchError, OSError, EOFError, TimeoutError):
+                        pass
+                if (time.monotonic() - self._last_progress) > self.timeout:
+                    raise TimeoutError(
+                        "data service made no progress for {}s (job {!r}, "
+                        "{} worker(s) listed)".format(self.timeout,
+                                                      self.job_name,
+                                                      len(roster)))
+                time.sleep(0.1)
+            # job complete: receiver threads exit on their stream_end; give
+            # a zombie stream a short grace, then force its socket closed —
+            # everything it still carries is a duplicate by construction
+            deadline = time.monotonic() + 2.0
+            with self._stream_lock:
+                threads = dict(self._streams)
+            for worker_id, t in threads.items():
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+                if t.is_alive():
+                    self._close_stream(worker_id)
+                    t.join(timeout=1.0)
+        except Exception as e:
+            self._errors.put(e)
+        finally:
+            client.close()
+            self._publish(_SENTINEL, force=True)
+
+    def _close_stream(self, worker_id):
+        with self._stream_lock:
+            sock = self._stream_socks.pop(worker_id, None)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- receive plane -----------------------------------------------------
+
+    def _receive_stream(self, worker_id, meta):
+        """One worker stream: dial, hello, then frames until stream_end."""
+        tracer = telemetry.get_tracer()
+        sock = None
+        cur = None       # (epoch, split) being buffered
+        pending = []     # buffered chunks of the current split
+        try:
+            try:
+                with tracer.span("dataservice/connect", worker_id=worker_id):
+                    sock = self.retry_policy.call(
+                        lambda: socket.create_connection(
+                            (meta["host"], meta["port"]), timeout=10.0))
+            except Exception as e:
+                # couldn't reach the worker at all: un-claim the stream slot
+                # so the maintainer may retry (bounded by _dial_failures)
+                with self._stream_lock:
+                    self._dial_failures[worker_id] = (
+                        self._dial_failures.get(worker_id, 0) + 1)
+                    self._streams.pop(worker_id, None)
+                logger.warning("servicefeed: cannot reach worker %s (%s)",
+                               worker_id, e)
+                return
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._stream_lock:
+                self._stream_socks[worker_id] = sock
+            _send_json(sock, {"job": self.job_name,
+                              "consumer": self.consumer_id})
+            self._last_progress = time.monotonic()
+            while not self._stop.is_set():
+                kind, payload = _recv_frame(sock)
+                if kind == _K_JSON:
+                    msg = json.loads(payload)
+                    mtype = msg.get("type")
+                    if mtype == "split_begin":
+                        cur = (int(msg["epoch"]), int(msg["split"]))
+                        pending = []
+                    elif mtype == "split_end":
+                        self._commit_split(
+                            (int(msg["epoch"]), int(msg["split"])), pending)
+                        cur, pending = None, []
+                    elif mtype == "stream_end":
+                        return
+                    continue
+                chunk = self._decode(kind, payload)
+                if self.mode == SHARD_OFF or cur is None:
+                    self._publish(chunk)  # no visitation ledger: commit now
+                else:
+                    pending.append(chunk)
+        except (EOFError, OSError) as e:
+            if self._stop.is_set():
+                return
+            if cur is not None or pending:
+                # worker died mid-split: the split was never committed, the
+                # dispatcher will re-pool it — drop the partial buffer
+                self.splits_discarded += 1
+                tracer.instant("dataservice/split_discard",
+                               worker_id=worker_id,
+                               split=cur[1] if cur else None)
+            logger.warning("servicefeed: stream to worker %s lost (%s)",
+                           worker_id, e)
+        except DispatchError as e:
+            logger.warning("servicefeed: stream to worker %s aborted (%s)",
+                           worker_id, e)
+        except Exception as e:
+            if not self._stop.is_set():
+                self._errors.put(e)
+        finally:
+            if sock is not None:
+                with self._stream_lock:
+                    self._stream_socks.pop(worker_id, None)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def _decode(self, kind, payload):
+        if kind == _K_COLV1:
+            # zero-copy: the frombuffer views pin `payload`, which is ours
+            chunk = wire.decode_chunk(payload, copy=False)
+            fmt = wire.WIRE_COLV1
+            n = chunk.count
+        elif kind == _K_PICKLE:
+            rows = pickle.loads(payload)
+            chunk = marker.Chunk(rows)
+            fmt = wire.WIRE_PICKLE
+            n = len(rows)
+        else:
+            raise DispatchError("unknown data frame kind {}".format(kind))
+        self.wire_formats[fmt] = self.wire_formats.get(fmt, 0) + 1
+        self.bytes_received += len(payload)
+        return chunk
+
+    def _commit_split(self, key, chunks):
+        """Exactly-once commit: dedupe, ledger DONE, then publish."""
+        with self._commit_lock:
+            if key in self._committed:
+                self.split_dupes += 1
+                return
+            self._committed.add(key)
+        # ledger first: once DONE lands the split can never be reassigned,
+        # and the chunks below are already safely buffered in this process
+        client = self.retry_policy.call(
+            lambda: DispatcherClient(self.dispatcher_addr))
+        try:
+            client.done_split(self.job_name, key[0], key[1],
+                              self.consumer_id)
+        finally:
+            client.close()
+        for chunk in chunks:
+            self._publish(chunk)
+        self.splits_committed += 1
+        self._last_progress = time.monotonic()
+        telemetry.get_tracer().instant(
+            "dataservice/split_commit", split=key[1], epoch=key[0],
+            consumer=self.consumer_id)
+
+    def _publish(self, item, force=False):
+        if item is _SENTINEL:
+            if self._sentinel_sent:
+                return
+            self._sentinel_sent = True
+        while True:
+            if self._stop.is_set() and not force:
+                return
+            try:
+                self._chunks.put(item, timeout=0.2)
+                return
+            except _queue.Full:
+                if force:
+                    # end-of-feed must land even against a full queue a
+                    # terminated consumer stopped draining
+                    try:
+                        self._chunks.get_nowait()
+                    except _queue.Empty:
+                        pass
+
+    # -- consumer surface (DataFeed duck type) -----------------------------
+
+    def _get_interruptible(self):
+        if not self._errors.empty():
+            raise self._errors.get()
+        t0 = time.monotonic()
+        try:
+            while not self._interrupt.is_set():
+                try:
+                    item = self._chunks.get(block=True, timeout=0.5)
+                except _queue.Empty:
+                    if not self._errors.empty():
+                        raise self._errors.get()
+                    continue
+                if item is _SENTINEL:
+                    self._done = True
+                    if not self._errors.empty():
+                        raise self._errors.get()
+                return item
+            return _INTERRUPTED
+        finally:
+            self.stall_secs += time.monotonic() - t0
+
+    def _buflen(self):
+        buf = self._buffer
+        return buf.count if isinstance(buf, marker.ColChunk) else len(buf)
+
+    def _bufrow(self, i):
+        buf = self._buffer
+        return buf.row(i) if isinstance(buf, marker.ColChunk) else buf[i]
+
+    def _next_chunk(self):
+        """Refill the row buffer; False at end-of-feed/interrupt."""
+        while True:
+            if self._done:
+                return False
+            item = self._get_interruptible()
+            if item is _INTERRUPTED or item is _SENTINEL:
+                return False
+            self._buffer = (item.items if isinstance(item, marker.Chunk)
+                            else item)
+            self._buffer_idx = 0
+            if self._buflen():
+                return True
+
+    def next_batch(self, batch_size):
+        """Up to ``batch_size`` rows; a list of items, or a dict of
+        per-tensor lists when ``input_mapping`` was given (the
+        ``DataFeed.next_batch`` contract)."""
+        self._ensure_started()
+        tensors = ([] if self.input_tensors is None
+                   else {tensor: [] for tensor in self.input_tensors})
+        count = 0
+        while count < batch_size:
+            if self._buffer_idx >= self._buflen():
+                if not self._next_chunk():
+                    break
+            item = self._bufrow(self._buffer_idx)
+            self._buffer_idx += 1
+            if self.input_tensors is None:
+                tensors.append(item)
+            else:
+                for i, tensor in enumerate(self.input_tensors):
+                    tensors[tensor].append(item[i])
+            count += 1
+        self.items_consumed += count
+        self._fault.on_items(count)
+        return tensors
+
+    def next_batch_arrays(self, batch_size, dtypes=None):
+        """Columnar ``(arrays, count)`` — the ``DataFeed.next_batch_arrays``
+        contract: per-tensor dict with ``input_mapping``, tuple of field
+        arrays for tuple rows, single array for single-value rows, dict of
+        per-key columns for dict rows (the ``FileFeed`` surface)."""
+        from tensorflowonspark_tpu import datafeed
+
+        self._ensure_started()
+        parts = []       # per-part tuple of per-field array slices
+        dict_rows = []   # dict-row accumulation (pickle-fallback path)
+        tuple_rows = None
+        count = 0
+        while count < batch_size:
+            buflen = self._buflen()
+            if self._buffer_idx >= buflen:
+                if not self._next_chunk():
+                    break
+                buflen = self._buflen()
+            take = min(batch_size - count, buflen - self._buffer_idx)
+            i0 = self._buffer_idx
+            buf = self._buffer
+            if isinstance(buf, marker.ColChunk):
+                fields, tr = tuple(c[i0:i0 + take]
+                                   for c in buf.columns), buf.tuple_rows
+            elif buf and isinstance(buf[0], dict):
+                if parts:
+                    raise ValueError("mixed dict and non-dict rows across "
+                                     "feed chunks")
+                dict_rows.extend(buf[i0:i0 + take])
+                self._buffer_idx += take
+                count += take
+                continue
+            else:
+                fields, tr = datafeed._rows_to_fields(buf[i0:i0 + take])
+            if dict_rows:
+                raise ValueError("mixed dict and non-dict rows across feed "
+                                 "chunks")
+            if tuple_rows is None:
+                tuple_rows = tr
+            elif tuple_rows != tr or (parts
+                                      and len(parts[-1]) != len(fields)):
+                raise ValueError(
+                    "inconsistent row structure across feed chunks "
+                    "(tuple_rows {} vs {})".format(tuple_rows, tr))
+            parts.append(fields)
+            self._buffer_idx += take
+            count += take
+        self.items_consumed += count
+        self._fault.on_items(count)
+        if dict_rows:
+            from tensorflowonspark_tpu.data import FileFeed
+
+            return FileFeed._columnar(dict_rows, dtypes), count
+        if not count:
+            return (np.empty((0,)) if self.input_tensors is None
+                    else {t: np.empty((0,)) for t in self.input_tensors}), 0
+        return datafeed.assemble_columns(parts, tuple_rows, dtypes,
+                                         self.input_tensors), count
+
+    def should_stop(self):
+        """True once end-of-feed was observed and the buffer is drained."""
+        return self._done and self._buffer_idx >= self._buflen()
+
+    def interrupt(self):
+        """Unblock a concurrent ``next_batch*`` (ShardedFeed handoff)."""
+        self._interrupt.set()
+
+    def terminate(self):
+        """Stop receiving, close streams, drop buffered data (early stop /
+        preemption drain).  Idempotent."""
+        self._interrupt.set()
+        self._stop.set()
+        with self._stream_lock:
+            workers = list(self._stream_socks)
+        for worker_id in workers:
+            self._close_stream(worker_id)
+        if self._maintainer is not None:
+            self._maintainer.join(timeout=2.0)
+        while True:
+            try:
+                self._chunks.get_nowait()
+            except _queue.Empty:
+                break
+        self._buffer, self._buffer_idx = [], 0
+        self._done = True
+
+    def counters_snapshot(self):
+        """Flat telemetry counters for heartbeat payloads (the
+        ``dataservice_*`` vocabulary merged into
+        ``TPUCluster.metrics_snapshot()``)."""
+        snap = {"dataservice_items": self.items_consumed,
+                "dataservice_stall_secs": round(self.stall_secs, 6),
+                "dataservice_splits": self.splits_committed,
+                "dataservice_split_dupes": self.split_dupes,
+                "dataservice_splits_discarded": self.splits_discarded,
+                "dataservice_bytes": self.bytes_received}
+        for fmt, n in list(self.wire_formats.items()):
+            snap["wire_{}".format(fmt)] = n
+        return snap

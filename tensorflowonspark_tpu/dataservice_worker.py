@@ -1,0 +1,70 @@
+"""CLI entry for a data-service feed worker process.
+
+Runs one :class:`~tensorflowonspark_tpu.dataservice.FeedWorker` until
+SIGTERM / Ctrl-C, then deregisters cleanly (``BYE``).  Chaos specs ride the
+usual ``TFOS_FAULT_SPEC`` environment variable (e.g.
+``{"kill_after_splits": 2}`` for the CI worker-kill gate).
+
+Usage::
+
+    python -m tensorflowonspark_tpu.dataservice_worker \\
+        --dispatcher HOST:PORT [--reader jsonl|tfrecord] [--host H] \\
+        [--port P] [--worker-id ID] [--heartbeat SECS] [--process-pool]
+"""
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="tensorflowonspark_tpu data-service feed worker")
+    parser.add_argument("--dispatcher", required=True,
+                        help="dispatcher address, host:port")
+    parser.add_argument("--reader", choices=("jsonl", "tfrecord"),
+                        default="tfrecord",
+                        help="row reader for split files (default: tfrecord)")
+    parser.add_argument("--host", default="127.0.0.1",
+                        help="data-port bind/advertise host")
+    parser.add_argument("--port", type=int, default=0,
+                        help="data port (default: ephemeral)")
+    parser.add_argument("--worker-id", default=None,
+                        help="worker identity (default: generated)")
+    parser.add_argument("--heartbeat", type=float, default=1.0,
+                        help="heartbeat interval seconds")
+    parser.add_argument("--process-pool", action="store_true",
+                        help="read splits with ProcessPoolFeed")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    from tensorflowonspark_tpu import data, dataservice
+
+    row_reader = (data.jsonl_rows if args.reader == "jsonl"
+                  else data.tfrecord_rows)
+    worker = dataservice.FeedWorker(
+        args.dispatcher, row_reader=row_reader, host=args.host,
+        port=args.port, worker_id=args.worker_id,
+        heartbeat_interval=args.heartbeat,
+        use_process_pool=args.process_pool)
+    worker.start()
+    print("worker {} ready on {}:{}".format(worker.worker_id, worker.host,
+                                            worker.port), flush=True)
+
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: done.set())
+    try:
+        done.wait()
+    except KeyboardInterrupt:
+        pass
+    worker.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

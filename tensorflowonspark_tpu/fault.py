@@ -194,6 +194,9 @@ class _NullInjector(object):
     def on_task(self):
         pass
 
+    def on_split(self, n=1):
+        pass
+
     def should_drop_heartbeat(self, beats_sent):
         return False
 
@@ -240,6 +243,9 @@ class FaultInjector(object):
       the previous retained step (``restore_latest_valid``).
     - ``kill_after_tasks``: SIGKILL the built-in backend's executor process
       after serving N tasks (whole-executor loss).
+    - ``kill_after_splits``: SIGKILL a data-service feed worker once it has
+      finished streaming N splits — the mid-job worker death whose splits
+      the dispatcher must re-pool (exactly-once visitation under failure).
     - ``drop_heartbeats_after``: heartbeat sender emits N beats, then goes
       silent while the process lives (tests missed-beat detection without a
       real death).
@@ -264,6 +270,7 @@ class FaultInjector(object):
         self._items = 0
         self._tasks = 0
         self._chunks = 0
+        self._splits = 0
 
     @staticmethod
     def _fired(kind, flush=False, **attrs):
@@ -338,6 +345,17 @@ class FaultInjector(object):
             logger.warning("FaultInjector: killing executor pid %d after %d "
                            "tasks", os.getpid(), self._tasks)
             self._fired("kill_after_tasks", flush=True, tasks=self._tasks)
+            self._kill_self()
+
+    def on_split(self, n=1):
+        """Data-service worker hook: count ``n`` finished splits and fire
+        ``kill_after_splits`` when crossed."""
+        self._splits += n
+        kill_at = self.spec.get("kill_after_splits")
+        if kill_at is not None and self._splits >= kill_at:
+            logger.warning("FaultInjector: killing feed worker pid %d after "
+                           "%d splits", os.getpid(), self._splits)
+            self._fired("kill_after_splits", flush=True, splits=self._splits)
             self._kill_self()
 
     def should_drop_heartbeat(self, beats_sent):

@@ -211,7 +211,7 @@ class TPUNodeContext(object):
 
     def __init__(self, executor_id, job_name, task_index, cluster_info,
                  default_fs, working_dir, mgr, coordinator_address,
-                 num_processes, process_id):
+                 num_processes, process_id, data_service=None):
         self.executor_id = executor_id
         self.worker_num = executor_id  # reference-compat alias (TFSparkNode.py:34)
         self.job_name = job_name
@@ -223,6 +223,9 @@ class TPUNodeContext(object):
         self.coordinator_address = coordinator_address
         self.num_processes = num_processes
         self.process_id = process_id
+        # disaggregated-data-service spec from cluster.run(data_service=):
+        # {"dispatcher": [host, port]} or None (see get_service_feed)
+        self.data_service = data_service
 
     @property
     def cluster_spec(self):
@@ -278,6 +281,36 @@ class TPUNodeContext(object):
         # Expose the feed's counters to the heartbeat metrics provider (the
         # real node module of this process, not the closure's copy — see
         # the _node_state comment in run()).
+        import tensorflowonspark_tpu.node as _node_mod
+
+        _node_mod._register_feed(feed)
+        return feed
+
+    def get_service_feed(self, files, dispatcher=None, **kwargs):
+        """Return a :class:`~tensorflowonspark_tpu.dataservice.ServiceFeed`
+        reading ``files`` through the disaggregated data service (the
+        FILES-mode analog of :meth:`get_data_feed` when ``cluster.run`` was
+        given ``data_service=``).
+
+        ``dispatcher`` overrides the cluster-configured address; remaining
+        kwargs pass through to ``ServiceFeed`` (``job_name``, ``mode``,
+        ``num_epochs``, ``input_mapping``, ...).  The consumer identity
+        defaults to this node's executor id."""
+        from tensorflowonspark_tpu import dataservice
+
+        if dispatcher is None:
+            if not self.data_service:
+                raise ValueError(
+                    "no data service configured: pass dispatcher= here or "
+                    "data_service= to cluster.run")
+            dispatcher = self.data_service["dispatcher"]
+        kwargs.setdefault("consumer_id",
+                          "executor-{}".format(self.executor_id))
+        feed = dataservice.ServiceFeed(dispatcher, files, **kwargs)
+        # same lifecycle wiring as get_data_feed: preemption drain stops the
+        # network streams, and the feed's dataservice_* counters ride this
+        # node's heartbeats into the driver's metrics snapshot
+        on_preemption(feed.terminate)
         import tensorflowonspark_tpu.node as _node_mod
 
         _node_mod._register_feed(feed)
@@ -542,6 +575,7 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             executor_id, job_name, task_index, cluster_info,
             cluster_meta.get("default_fs", "file://"), os.getcwd(), mgr,
             coordinator_address, num_processes, process_id,
+            data_service=cluster_meta.get("data_service"),
         )
 
         if release_port:

@@ -83,11 +83,27 @@ def enforce_env_platforms():
     cfg = jax.config.jax_platforms or ""
     if cfg.split(",")[0] == env.split(",")[0]:
         return
+    # Probe whether backends were already initialized.  Prefer the named
+    # probe function when this jax version exports one; fall back to the
+    # private backend cache; if neither is reachable the answer is UNKNOWN
+    # (None) — not "no" — and the update still goes through: a wrong config
+    # on an uninitialized process is the expensive failure (touching a
+    # deselected accelerator), a redundant config update on an initialized
+    # one is inert.
+    initialized = None
     try:
         from jax._src import xla_bridge
-        initialized = bool(xla_bridge._backends)
-    except Exception:
-        initialized = False
+
+        probe = getattr(xla_bridge, "backends_are_initialized", None)
+        if callable(probe):
+            initialized = bool(probe())
+        else:
+            initialized = bool(xla_bridge._backends)
+    except Exception as e:
+        logger.debug(
+            "cannot probe jax backend initialization (%s: %s); assuming "
+            "uninitialized and updating jax_platforms",
+            type(e).__name__, e)
     if initialized:
         logger.warning(
             "JAX_PLATFORMS=%s cannot take effect: backends already "

@@ -47,7 +47,8 @@ import numpy as np
 
 __all__ = [
     "FrameError", "WIRE_PICKLE", "WIRE_COLV1", "enabled",
-    "encode", "encode_chunk", "frame_bytes", "decode", "decode_chunk",
+    "encode", "encode_chunk", "frame_bytes", "frame_chunk_bytes", "decode",
+    "decode_chunk",
 ]
 
 MAGIC = b"TFWC"
@@ -122,6 +123,14 @@ def frame_bytes(columns, count, tuple_rows):
         return None
     return b"".join(p.tobytes() if isinstance(p, np.ndarray) else p
                     for p in parts)
+
+
+def frame_chunk_bytes(chunk):
+    """One contiguous frame for a
+    :class:`~tensorflowonspark_tpu.marker.ColChunk` (``None`` when not
+    framable) — the byte-stream transports' convenience (TCP data service);
+    the ring path uses :func:`encode_chunk`'s gather parts."""
+    return frame_bytes(chunk.columns, chunk.count, chunk.tuple_rows)
 
 
 def decode(buf, copy=True):

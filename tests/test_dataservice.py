@@ -1,0 +1,468 @@
+"""Data-service tests: dispatcher ledger semantics, framed TCP transport
+parity, exactly-once visitation under worker death, and the ServiceFeed
+drop-in contract — all on localhost, CPU-only.
+
+The wall-clock-sensitive tests (worker kill → fence → reassign; the
+fit_supervised drop-in run) carry the ``chaos`` marker's SIGALRM limit so
+a broken recovery path fails with stacks instead of hanging the suite."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import data, dataservice, wire
+from tensorflowonspark_tpu.dataservice import (
+    SHARD_DYNAMIC, SHARD_OFF, SHARD_STATIC, DispatchError, DispatcherClient,
+    DispatcherServer, FeedWorker, ServiceFeed)
+
+
+def _write_jsonl(dirpath, n_splits, per_split, row_fn=None):
+    """``n_splits`` jsonl files of ``per_split`` rows; returns
+    ``(split_paths, all_rows)``.  Default rows are globally-unique ints
+    (single-value rows → framable colv1 columns)."""
+    row_fn = row_fn or (lambda i: i)
+    splits, rows = [], []
+    for s in range(n_splits):
+        path = os.path.join(str(dirpath), "split-{:03d}.jsonl".format(s))
+        with open(path, "w") as f:
+            for i in range(s * per_split, (s + 1) * per_split):
+                row = row_fn(i)
+                rows.append(tuple(row) if isinstance(row, list) else row)
+                f.write(json.dumps(row) + "\n")
+        splits.append(path)
+    return splits, rows
+
+
+class _Service(object):
+    """In-process dispatcher + N feed workers with fast heartbeats."""
+
+    def __init__(self, n_workers=2, heartbeat=0.2, misses=2):
+        self.dispatcher = DispatcherServer(heartbeat_interval=heartbeat,
+                                           heartbeat_misses=misses,
+                                           host="127.0.0.1")
+        self.addr = self.dispatcher.start()
+        self.workers = [
+            FeedWorker(self.addr, row_reader=data.jsonl_rows,
+                       worker_id="w{}".format(i),
+                       heartbeat_interval=heartbeat).start()
+            for i in range(n_workers)]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        for w in self.workers:
+            w.stop()
+        self.dispatcher.stop()
+
+
+def _drain(feed, batch_size=32, timeout=30.0):
+    """All rows out of a feed via next_batch_arrays (single-value rows)."""
+    got = []
+    deadline = time.monotonic() + timeout
+    while not feed.should_stop():
+        assert time.monotonic() < deadline, "feed did not complete"
+        arrays, count = feed.next_batch_arrays(batch_size)
+        if count:
+            got.extend(arrays.tolist())
+    return got
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher control plane
+# ---------------------------------------------------------------------------
+
+def test_worker_registration_roster_and_bye():
+    disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1")
+    addr = disp.start()
+    try:
+        client = DispatcherClient(addr)
+        client.register_worker("wa", "127.0.0.1", 1111)
+        client.register_worker("wb", "127.0.0.1", 2222)
+        roster = client.workers()
+        assert [m["worker_id"] for m in roster] == ["wa", "wb"]
+        assert roster[0]["port"] == 1111
+        # duplicate live id is a configuration error, not a silent replace
+        with pytest.raises(DispatchError, match="duplicate"):
+            client.register_worker("wa", "127.0.0.1", 3333)
+        # clean BYE (the HeartbeatSender wire shape) leaves the roster
+        client.goodbye("wa")
+        assert [m["worker_id"] for m in client.workers()] == ["wb"]
+        client.close()
+    finally:
+        disp.stop()
+
+
+def test_fenced_worker_is_rejected_and_splits_reassigned():
+    """Liveness fence: a silent worker is declared dead, its identity is
+    burned (no re-registration, no more TASKs), and its assigned splits
+    re-pool bound to the same consumer."""
+    disp = DispatcherServer(heartbeat_interval=0.1, heartbeat_misses=2,
+                            host="127.0.0.1")
+    addr = disp.start()
+    try:
+        client = DispatcherClient(addr)
+        client.register_worker("wz", "127.0.0.1", 1111)
+        client.register_job("j", ["s0", "s1"], mode=SHARD_DYNAMIC)
+        task = client.request_task("j", "wz", "c0")
+        assert task["splits"] == [[0, "s0"]]
+        deadline = time.monotonic() + 5
+        while "wz" not in disp.dead_workers():
+            assert time.monotonic() < deadline, "worker never fenced"
+            time.sleep(0.05)
+        status = client.status("j")
+        assert status["reassigned"] == 1 and status["pending"] == 1
+        with pytest.raises(DispatchError, match="fresh identity"):
+            client.register_worker("wz", "127.0.0.1", 1111)
+        with pytest.raises(DispatchError, match="marked dead"):
+            client.request_task("j", "wz", "c0")
+        # a survivor picks the orphan up FOR THE SAME consumer...
+        client.register_worker("wy", "127.0.0.1", 2222)
+        assert client.request_task("j", "wy", "other")["splits"] == \
+            [[1, "s1"]]  # ...so another consumer only gets fresh splits
+        assert client.request_task("j", "wy", "c0")["splits"] == [[0, "s0"]]
+        client.close()
+    finally:
+        disp.stop()
+
+
+def test_job_registration_is_idempotent_but_spec_changes_error():
+    disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1")
+    addr = disp.start()
+    try:
+        client = DispatcherClient(addr)
+        assert client.register_job("j", ["a", "b"], num_epochs=2) is True
+        assert client.register_job("j", ["a", "b"], num_epochs=2) is False
+        with pytest.raises(DispatchError, match="different spec"):
+            client.register_job("j", ["a", "b"], num_epochs=3)
+        with pytest.raises(DispatchError, match="sharding mode"):
+            client.register_job("k", ["a"], mode="bogus")
+        client.close()
+    finally:
+        disp.stop()
+
+
+def test_done_split_is_idempotent_and_epochs_advance():
+    disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1")
+    addr = disp.start()
+    try:
+        client = DispatcherClient(addr)
+        client.register_worker("w", "127.0.0.1", 1)
+        client.register_job("j", ["s0"], num_epochs=2)
+        assert client.request_task("j", "w", "c")["epoch"] == 0
+        client.done_split("j", 0, 0, "c")
+        client.done_split("j", 0, 0, "c")  # duplicate: harmless
+        client.done_split("j", 5, 0, "c")  # stale epoch: harmless
+        assert client.status("j")["epoch"] == 1
+        assert client.request_task("j", "w", "c")["epoch"] == 1
+        client.done_split("j", 1, 0, "c")
+        assert client.status("j")["done"]
+        assert client.request_task("j", "w", "c") == {"type": "TASK",
+                                                      "done": True}
+        client.close()
+    finally:
+        disp.stop()
+
+
+# ---------------------------------------------------------------------------
+# Sharding modes end to end
+# ---------------------------------------------------------------------------
+
+def test_off_mode_each_stream_delivers_full_dataset(tmp_path):
+    splits, rows = _write_jsonl(tmp_path, 3, 10)
+    with _Service(n_workers=2) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="off", mode=SHARD_OFF,
+                           min_workers=2, timeout=20.0)
+        try:
+            got = _drain(feed)
+            # W workers × the dataset: OFF trades the visitation guarantee
+            # for coordination-free streams
+            assert sorted(got) == sorted(list(rows) * 2)
+        finally:
+            feed.terminate()
+
+
+def test_static_mode_exactly_once_with_frozen_ownership(tmp_path):
+    splits, rows = _write_jsonl(tmp_path, 6, 10)
+    with _Service(n_workers=2) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="st",
+                           mode=SHARD_STATIC, timeout=20.0)
+        try:
+            got = _drain(feed)
+            assert sorted(got) == sorted(rows)
+            # round-robin ownership over 2 live workers: 3 splits each
+            assert sorted(w.splits_streamed for w in svc.workers) == [3, 3]
+        finally:
+            feed.terminate()
+
+
+def test_dynamic_mode_multi_epoch_exactly_once(tmp_path):
+    splits, rows = _write_jsonl(tmp_path, 5, 8)
+    with _Service(n_workers=2) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="dyn",
+                           mode=SHARD_DYNAMIC, num_epochs=3, timeout=20.0)
+        try:
+            got = _drain(feed)
+            assert sorted(got) == sorted(list(rows) * 3)
+            snap = feed.counters_snapshot()
+            assert snap["dataservice_splits"] == 15
+            assert snap["dataservice_split_dupes"] == 0
+        finally:
+            feed.terminate()
+
+
+@pytest.mark.chaos(timeout=60)
+def test_dynamic_worker_killed_mid_epoch_exactly_once(tmp_path):
+    """The visitation guarantee under failure (the tentpole's acceptance
+    bar): a worker dies mid-epoch after streaming some splits; the
+    dispatcher fences it and re-pools its uncompleted splits; the survivor
+    re-streams them; the consumer sees every element exactly once —
+    nothing lost, nothing duplicated (the test_chaos counting idiom)."""
+    splits, rows = _write_jsonl(tmp_path, 10, 40)
+    with _Service(n_workers=2, heartbeat=0.2, misses=2) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="kill",
+                           mode=SHARD_DYNAMIC, timeout=30.0)
+
+        def killer():
+            deadline = time.monotonic() + 20
+            while (svc.workers[0].splits_streamed < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+            svc.workers[0].stop(abrupt=True)  # crash: no BYE, beats stop
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        try:
+            got = _drain(feed, timeout=40.0)
+            kt.join(timeout=10)
+            assert sorted(got) == sorted(rows)
+            status = DispatcherClient(svc.addr).status("kill")
+            assert status["done"]
+            assert status["dead_workers"] == 1
+            snap = feed.counters_snapshot()
+            assert snap["dataservice_split_dupes"] == 0
+        finally:
+            feed.terminate()
+
+
+# ---------------------------------------------------------------------------
+# Transport parity
+# ---------------------------------------------------------------------------
+
+def test_colv1_transport_parity_with_local_filefeed(tmp_path):
+    """Element-identical to reading the same files with a local FileFeed,
+    and the transport really was colv1 frames (no pickle fallback)."""
+    splits, _ = _write_jsonl(tmp_path, 4, 25)
+    local = data.FileFeed(splits, row_reader=data.jsonl_rows,
+                          reader_threads=1, shard=False)
+    expected = []
+    while not local.should_stop():
+        arrays, count = local.next_batch_arrays(32)
+        if count:
+            expected.extend(arrays.tolist())
+    with _Service(n_workers=2) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="parity",
+                           mode=SHARD_DYNAMIC, timeout=20.0)
+        try:
+            got = _drain(feed)
+            assert sorted(got) == sorted(expected)
+            assert feed.wire_formats.get(wire.WIRE_COLV1, 0) > 0
+            assert wire.WIRE_PICKLE not in feed.wire_formats
+        finally:
+            feed.terminate()
+
+
+def test_dict_rows_fall_back_to_pickle_and_assemble_columnar(tmp_path):
+    """Object/dict rows aren't colv1-framable: the worker pickles them (the
+    _ChunkPutter fallback rule) and the consumer still assembles columnar
+    batches keyed by field name."""
+    splits, rows = _write_jsonl(
+        tmp_path, 3, 10, row_fn=lambda i: {"x": [float(i), 2.0 * i],
+                                           "y": float(i)})
+    with _Service(n_workers=2) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="dicts",
+                           mode=SHARD_DYNAMIC, timeout=20.0)
+        try:
+            got_y = []
+            deadline = time.monotonic() + 30
+            while not feed.should_stop():
+                assert time.monotonic() < deadline
+                arrays, count = feed.next_batch_arrays(16)
+                if count:
+                    assert set(arrays) == {"x", "y"}
+                    assert arrays["x"].shape == (count, 2)
+                    got_y.extend(arrays["y"].tolist())
+            assert sorted(got_y) == sorted(r["y"] for r in rows)
+            assert feed.wire_formats.get(wire.WIRE_PICKLE, 0) > 0
+            assert wire.WIRE_COLV1 not in feed.wire_formats
+        finally:
+            feed.terminate()
+
+
+def test_next_batch_with_input_mapping_and_pickle_env_knob(tmp_path, monkeypatch):
+    """TFOS_WIRE_FORMAT=pickle forces the pickled transport end to end (the
+    A/B knob), and next_batch honors the input_mapping per-tensor-dict
+    contract for tuple rows."""
+    monkeypatch.setenv("TFOS_WIRE_FORMAT", "pickle")
+    splits, rows = _write_jsonl(tmp_path, 2, 8,
+                                row_fn=lambda i: [float(i), float(-i)])
+    with _Service(n_workers=1) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="nb",
+                           mode=SHARD_DYNAMIC,
+                           input_mapping={"a": "x", "b": "y"}, timeout=20.0)
+        try:
+            got_x, got_y = [], []
+            deadline = time.monotonic() + 30
+            while not feed.should_stop():
+                assert time.monotonic() < deadline
+                batch = feed.next_batch(5)
+                assert set(batch) == {"x", "y"}
+                got_x.extend(batch["x"])
+                got_y.extend(batch["y"])
+            assert sorted(got_x) == sorted(r[0] for r in rows)
+            assert sorted(got_y) == sorted(r[1] for r in rows)
+            assert feed.wire_formats.get(wire.WIRE_PICKLE, 0) > 0
+            assert wire.WIRE_COLV1 not in feed.wire_formats
+        finally:
+            feed.terminate()
+
+
+def test_frame_chunk_bytes_round_trip():
+    from tensorflowonspark_tpu import marker
+
+    chunk = marker.ColChunk(
+        (np.arange(12, dtype=np.float32).reshape(6, 2),
+         np.arange(6, dtype=np.int64)), 6, True)
+    buf = wire.frame_chunk_bytes(chunk)
+    out = wire.decode_chunk(buf)
+    assert out.count == 6 and out.tuple_rows
+    np.testing.assert_array_equal(out.columns[0], chunk.columns[0])
+    np.testing.assert_array_equal(out.columns[1], chunk.columns[1])
+    # object columns aren't framable -> None (callers fall back to pickle)
+    ragged = marker.ColChunk(
+        (np.array([[1], [2, 3]], dtype=object),), 2, False)
+    assert wire.frame_chunk_bytes(ragged) is None
+
+
+def test_jsonl_rows_row_shapes(tmp_path):
+    path = os.path.join(str(tmp_path), "rows.jsonl")
+    with open(path, "w") as f:
+        f.write('{"a": 1}\n')
+        f.write("[1.5, 2.5]\n")
+        f.write("\n")          # blank lines skipped
+        f.write("7\n")
+    rows = list(data.jsonl_rows(path))
+    # top-level arrays become TUPLE rows (fields), not list values
+    assert rows == [{"a": 1}, (1.5, 2.5), 7]
+
+
+# ---------------------------------------------------------------------------
+# ServiceFeed drop-in: fit_supervised on a 2-consumer run
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos(timeout=120)
+def test_fit_supervised_two_consumers_share_the_job(tmp_path):
+    """The drop-in acceptance: consumer 0 trains with fit_supervised through
+    ShardedFeed on a ServiceFeed; consumer 1 is a plain drain loop on the
+    SAME job.  DYNAMIC sharding splits the dataset between them
+    first-come-first-served, and their combined consumption is the dataset
+    exactly once."""
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint as ckpt_mod
+    from tensorflowonspark_tpu.parallel import build_mesh
+    from tensorflowonspark_tpu.parallel.infeed import ShardedFeed
+    from tensorflowonspark_tpu.train import Trainer, fit_supervised
+
+    rng = np.random.RandomState(0)
+
+    def row_fn(i):
+        x = [float(v) for v in rng.rand(2)]
+        return [x, float(np.dot(x, [3.14, 1.618]))]
+
+    splits, rows = _write_jsonl(tmp_path, 12, 8, row_fn=row_fn)
+    mesh = build_mesh()
+
+    with _Service(n_workers=2) as svc:
+        other = ServiceFeed(svc.addr, splits, job_name="fit",
+                            mode=SHARD_DYNAMIC, consumer_id="c-drain",
+                            timeout=60.0)
+        drained = []
+
+        def drain_other():
+            while not other.should_stop():
+                _, count = other.next_batch_arrays(16)
+                drained.append(count)
+
+        dt = threading.Thread(target=drain_other, daemon=True)
+        dt.start()
+
+        trainer_feed = ServiceFeed(svc.addr, splits, job_name="fit",
+                                   mode=SHARD_DYNAMIC, consumer_id="c-fit",
+                                   input_mapping={"a_x": "x", "b_y": "y"},
+                                   timeout=60.0)
+        sharded = ShardedFeed(trainer_feed, mesh, global_batch_size=8,
+                              prefetch=0)
+
+        def loss(params, batch, mask):
+            pred = jnp.asarray(batch["x"]) @ params["w"]
+            err = (pred - jnp.asarray(batch["y"])) ** 2 * mask
+            return err.sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+        trainer = Trainer(loss, {"w": jnp.zeros((2,))}, optax.sgd(0.05),
+                          mesh=mesh, batch_size=8, log_steps=2)
+        ckpt = ckpt_mod.CheckpointManager(str(tmp_path / "ckpt"),
+                                          save_interval_steps=1)
+        try:
+            fit_supervised(trainer, lambda: sharded, ckpt)
+            dt.join(timeout=60)
+            assert not dt.is_alive()
+            total = trainer_feed.items_consumed + sum(drained)
+            assert total == len(rows)
+            assert (trainer_feed.splits_committed + other.splits_committed
+                    == len(splits))
+            assert trainer_feed.split_dupes == other.split_dupes == 0
+        finally:
+            ckpt.close()
+            trainer_feed.terminate()
+            other.terminate()
+
+
+# ---------------------------------------------------------------------------
+# Satellite units
+# ---------------------------------------------------------------------------
+
+def test_stablehlo_platform_mismatch_classifier():
+    from tensorflowonspark_tpu.serving import _stablehlo_platform_mismatch
+
+    assert _stablehlo_platform_mismatch(ValueError(
+        "Function 'apply' was lowered for platforms '('tpu',)' but it is "
+        "used on '('cpu',)'."))
+    assert _stablehlo_platform_mismatch(ValueError(
+        "the exported function is not compatible with platform cpu"))
+    # anything else must propagate: bad feeds, OOMs, real bugs
+    assert not _stablehlo_platform_mismatch(ValueError("RESOURCE_EXHAUSTED"))
+    assert not _stablehlo_platform_mismatch(KeyError("x"))
+    assert not _stablehlo_platform_mismatch(ValueError(
+        "platform configuration invalid"))
+
+
+def test_assemble_columns_module_function():
+    from tensorflowonspark_tpu.datafeed import assemble_columns
+
+    # empty parts honor the input_tensors shape contract
+    empty = assemble_columns([], True, None, None)
+    assert empty.shape == (0,)
+    assert set(assemble_columns([], True, None, ["x"])) == {"x"}
+    parts = [(np.arange(3), np.ones(3)), (np.arange(3, 5), np.ones(2))]
+    out = assemble_columns(parts, True, None, None)
+    assert isinstance(out, tuple) and out[0].shape == (5,)
+    named = assemble_columns(parts, True, None, ["x", "y"])
+    np.testing.assert_array_equal(named["x"], np.arange(5))
+    with pytest.raises(ValueError, match="fields"):
+        assemble_columns(parts, True, None, ["only_one"])

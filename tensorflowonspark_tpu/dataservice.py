@@ -30,15 +30,22 @@ composes the framework's existing planes into exactly that shape:
 Exactly-once protocol (STATIC / DYNAMIC): a split travels as
 ``split_begin`` → data frames → ``split_end`` on one worker→consumer
 stream.  The consumer buffers the split's frames and **commits** only on
-``split_end``: first it reports ``DONE`` to the dispatcher (marking the
-split visited in the ledger), then it publishes the buffered chunks to
-its batch queue.  A worker death mid-split drops the connection before
-``split_end`` — the consumer discards the partial buffer, the dispatcher
-fences the worker and re-pools its uncompleted splits (bound to the same
-consumer), and a surviving worker re-streams them.  A consumer-side
-``(epoch, split)`` dedupe set makes the race between a fenced-but-alive
-zombie worker and the reassigned replacement harmless: whichever
-``split_end`` lands first wins, the other is discarded.
+``split_end``: it publishes the buffered chunks to its batch queue
+exactly once (the ``(epoch, split)`` dedupe set), then reports ``DONE``
+to the dispatcher at-least-once (``DONE`` is idempotent; a failed report
+parks and is retried by the maintainer thread).  Publish-before-DONE
+means the ledger can never say a job is done while committed chunks are
+still unpublished — the completion path waits for receivers and never
+evicts the queue.  A worker death mid-split drops the connection before
+``split_end`` — the consumer discards the partial buffer, reports the
+split ``LOST`` so the dispatcher re-pools it immediately (worker fencing
+remains the backstop), and a surviving worker — or a redial of the same,
+still-live worker after a transient TCP reset — re-streams it.  The
+dedupe set makes the race between a fenced-but-alive zombie worker and
+the reassigned replacement harmless: whichever ``split_end`` lands first
+wins, the other is discarded.  A split a worker cannot *read* is aborted
+in-band (``split_abort`` + ``SPLIT_ERR``): the dispatcher re-pools it up
+to a small budget, then fails the job with the reader's error.
 
 Wire protocol: the dispatcher speaks the length-prefixed-JSON
 ``MessageSocket`` idiom of :mod:`~tensorflowonspark_tpu.reservation`
@@ -95,6 +102,12 @@ _K_PICKLE = 2   # pickled row list (object/ragged fallback)
 
 _SENTINEL = object()     # internal end-of-feed marker on the chunk queue
 _INTERRUPTED = object()  # internal next_batch abort marker
+
+#: Reader failures tolerated per split before the job fails with the
+#: reader's error.  One re-pool covers a transient fault on one worker; a
+#: split no worker can read must fail the job with a pointer to the file,
+#: not wedge it.
+_SPLIT_ERROR_BUDGET = 2
 
 
 class DispatchError(RuntimeError):
@@ -177,6 +190,8 @@ class _Job(object):
         self.mode = mode
         self.epoch = 0
         self.done = not self.splits or self.num_epochs <= 0
+        self.error = None          # set => job failed (unreadable split)
+        self.split_errors = {}     # split idx -> reader-failure count
         self.reassigned = 0        # splits re-pooled from dead workers (total)
         self.static_owner = None   # split idx -> worker_id (STATIC, lazy)
         self.off_served = set()    # (worker, consumer) streams served (OFF)
@@ -241,7 +256,8 @@ class _Job(object):
 
     def complete(self, epoch, split, consumer_id):
         """Consumer's ``DONE`` for a committed split; idempotent."""
-        if self.mode == SHARD_OFF or self.done or epoch != self.epoch:
+        if (self.mode == SHARD_OFF or self.done or self.error is not None
+                or epoch != self.epoch):
             return {"ok": True, "stale": True}
         if split in self.completed:
             return {"ok": True, "duplicate": True}
@@ -278,9 +294,45 @@ class _Job(object):
         self.reassigned += moved
         return moved
 
+    def release_split(self, epoch, split, worker_id, consumer_id):
+        """Re-pool one split whose worker→consumer stream broke while the
+        worker may still be alive (the consumer's ``LOST`` report) —
+        recovery without waiting for a heartbeat fence.  Idempotent and
+        stale-safe like :meth:`complete`."""
+        if (self.mode == SHARD_OFF or self.done or self.error is not None
+                or epoch != self.epoch or split in self.completed):
+            return {"ok": True, "stale": True}
+        if self.assigned.get(split) != (worker_id, consumer_id):
+            return {"ok": True, "stale": True}
+        del self.assigned[split]
+        self.pending.setdefault(consumer_id, []).append(split)
+        self.reassigned += 1
+        return {"ok": True}
+
+    def record_split_error(self, epoch, split, worker_id, consumer_id, desc):
+        """A worker failed to READ a split (its stream is intact).  Re-pool
+        it for another attempt up to :data:`_SPLIT_ERROR_BUDGET`; past the
+        budget the job fails carrying the reader's error, so consumers
+        surface the cause instead of retrying an unreadable file forever."""
+        if (self.mode == SHARD_OFF or self.done or self.error is not None
+                or epoch != self.epoch or split in self.completed):
+            return {"ok": True, "stale": True}
+        if self.assigned.get(split) == (worker_id, consumer_id):
+            del self.assigned[split]
+        n = self.split_errors.get(split, 0) + 1
+        self.split_errors[split] = n
+        if n >= _SPLIT_ERROR_BUDGET:
+            self.error = ("split {} ({!r}) unreadable after {} attempt(s), "
+                          "last on worker {}: {}".format(
+                              split, self.splits[split], n, worker_id, desc))
+            return {"ok": True, "failed": True}
+        self.pending.setdefault(consumer_id, []).append(split)
+        self.reassigned += 1
+        return {"ok": True}
+
     def status(self):
         return {"job": self.name, "mode": self.mode, "epoch": self.epoch,
-                "num_epochs": self.num_epochs,
+                "num_epochs": self.num_epochs, "error": self.error,
                 "num_splits": len(self.splits), "done": self.done,
                 "completed": len(self.completed),
                 "assigned": len(self.assigned),
@@ -306,7 +358,10 @@ class DispatcherServer(MessageSocket):
     ``HBEAT``/``BYE`` (byte-compatible with the rendezvous, so workers
     reuse ``HeartbeatSender``), ``JOB`` (idempotent job creation),
     ``WORKERS`` (live roster for consumers), ``TASK`` (split request),
-    ``DONE`` (consumer's split-visited report), ``STATUS``, ``STOP``.
+    ``DONE`` (consumer's split-visited report), ``LOST`` (consumer's
+    broken-stream report: re-pool the mid-flight split without waiting
+    for a fence), ``SPLIT_ERR`` (worker's reader-fault report: re-pool up
+    to a budget, then fail the job with the cause), ``STATUS``, ``STOP``.
     """
 
     def __init__(self, heartbeat_interval=1.0, heartbeat_misses=3,
@@ -467,10 +522,57 @@ class DispatcherServer(MessageSocket):
                     self.send(sock, {"type": "ERR",
                                      "error": "marked dead by the liveness "
                                               "monitor"})
+                elif job.error is not None:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "job {!r} failed: {}".format(
+                                         job.name, job.error)})
                 else:
                     ans = job.next_splits(worker_id, data.get("consumer_id"),
                                           list(self._workers))
                     ans["type"] = "TASK"
+                    self.send(sock, ans)
+            elif mtype == "LOST":
+                job = self._jobs.get(data.get("job"))
+                if job is None:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "unknown job {!r}"
+                                              .format(data.get("job"))})
+                else:
+                    ans = job.release_split(int(data.get("epoch", 0)),
+                                            int(data.get("split", -1)),
+                                            data.get("worker_id"),
+                                            data.get("consumer_id"))
+                    if not ans.get("stale"):
+                        logger.warning(
+                            "dataservice: split %s of job %r re-pooled "
+                            "after a broken stream to worker %s",
+                            data.get("split"), job.name,
+                            data.get("worker_id"))
+                        telemetry.get_tracer().instant(
+                            "dataservice/split_lost", job=job.name,
+                            split=int(data.get("split", -1)),
+                            worker_id=data.get("worker_id"))
+                    ans["type"] = "OK"
+                    self.send(sock, ans)
+            elif mtype == "SPLIT_ERR":
+                job = self._jobs.get(data.get("job"))
+                if job is None:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "unknown job {!r}"
+                                              .format(data.get("job"))})
+                else:
+                    ans = job.record_split_error(
+                        int(data.get("epoch", 0)),
+                        int(data.get("split", -1)),
+                        data.get("worker_id"), data.get("consumer_id"),
+                        data.get("error") or "reader failure")
+                    if ans.get("failed"):
+                        logger.error("dataservice: job %r failed: %s",
+                                     job.name, job.error)
+                        telemetry.get_tracer().instant(
+                            "dataservice/job_failed", job=job.name,
+                            error=job.error)
+                    ans["type"] = "OK"
                     self.send(sock, ans)
             elif mtype == "DONE":
                 job = self._jobs.get(data.get("job"))
@@ -607,6 +709,21 @@ class DispatcherClient(Client):
         return self._call("DONE", {"job": job, "epoch": epoch,
                                    "split": split,
                                    "consumer_id": consumer_id})
+
+    def lost_split(self, job, epoch, split, worker_id, consumer_id):
+        """Report a broken worker→consumer stream: the dispatcher re-pools
+        the mid-flight split immediately (no fence wait)."""
+        return self._call("LOST", {"job": job, "epoch": epoch,
+                                   "split": split, "worker_id": worker_id,
+                                   "consumer_id": consumer_id})
+
+    def split_error(self, job, epoch, split, worker_id, consumer_id, error):
+        """Report a worker-side reader fault on a split."""
+        return self._call("SPLIT_ERR", {"job": job, "epoch": epoch,
+                                        "split": split,
+                                        "worker_id": worker_id,
+                                        "consumer_id": consumer_id,
+                                        "error": error})
 
     def status(self, job):
         return self._call("STATUS", {"job": job}).get("data") or {}
@@ -763,8 +880,9 @@ class FeedWorker(object):
                     break
                 for _ in range(int(task.get("epochs", 1))):
                     for split, path in task["splits"]:
-                        self._stream_split(conn, split,
-                                           int(task.get("epoch", 0)), path)
+                        self._stream_split(conn, client, job, consumer,
+                                           split, int(task.get("epoch", 0)),
+                                           path)
         except (EOFError, OSError) as e:
             logger.info("feed worker %s: stream closed (%s)",
                         self.worker_id, e)
@@ -796,26 +914,62 @@ class FeedWorker(object):
         return data.FileFeed([path], row_reader=self.row_reader,
                              reader_threads=1, shard=False)
 
-    def _stream_split(self, conn, split, epoch, path):
+    def _stream_split(self, conn, client, job, consumer, split, epoch, path):
+        # Reader faults (unreadable file, bad records) are kept separate
+        # from socket faults: the reader calls sit in their own try so an
+        # OSError from the filesystem is never mistaken for a dead stream.
         tracer = telemetry.get_tracer()
         with tracer.span("dataservice/split_stream", split=split,
                          epoch=epoch, worker_id=self.worker_id):
             _send_json(conn, {"type": "split_begin", "split": split,
                               "epoch": epoch})
-            feed = self._make_feed(path)
-            feed._ensure_started()
+            feed = None
             try:
+                try:
+                    feed = self._make_feed(path)
+                    feed._ensure_started()
+                except Exception as e:
+                    self._abort_split(conn, client, job, consumer, split,
+                                      epoch, e)
+                    return
                 while not self._stop.is_set():
-                    block = feed._next_rows()
+                    try:
+                        block = feed._next_rows()
+                    except Exception as e:
+                        self._abort_split(conn, client, job, consumer,
+                                          split, epoch, e)
+                        return
                     if block is None:
                         break
                     self._send_block(conn, block)
             finally:
-                feed.terminate()
+                if feed is not None:
+                    feed.terminate()
             _send_json(conn, {"type": "split_end", "split": split,
                               "epoch": epoch})
         self.splits_streamed += 1
         self._injector.on_split()
+
+    def _abort_split(self, conn, client, job, consumer, split, epoch, exc):
+        """In-band recovery from a reader fault: the stream is healthy, so
+        tell the consumer to drop the partial buffer (``split_abort``) and
+        the dispatcher to re-pool or fail the split (``SPLIT_ERR``) — the
+        alternative, letting the exception kill the stream, would leave
+        the split assigned to a live worker forever with no diagnosis."""
+        desc = "{}: {}".format(type(exc).__name__, exc)
+        logger.warning("feed worker %s: split %s of job %r failed to read "
+                       "(%s)", self.worker_id, split, job, desc)
+        telemetry.get_tracer().instant(
+            "dataservice/split_error", worker_id=self.worker_id,
+            split=split, error=desc)
+        _send_json(conn, {"type": "split_abort", "split": split,
+                          "epoch": epoch, "error": desc})
+        try:
+            client.split_error(job, epoch, split, self.worker_id, consumer,
+                               desc)
+        except DispatchError as e:
+            logger.warning("feed worker %s: SPLIT_ERR refused (%s)",
+                           self.worker_id, e)
 
     def _send_block(self, conn, block):
         payload = None
@@ -870,8 +1024,11 @@ class ServiceFeed(object):
       prefetch: chunk-queue depth (≥2: double buffering).
       min_workers: wait for this many workers before binding (OFF mode
         binds its worker set once, see :data:`SHARD_OFF`).
-      timeout: seconds without progress (no connect, no commit) before the
-        feed raises — turns a dead service into an error, not a hang.
+      timeout: seconds without progress before the feed raises — turns a
+        dead service into an error, not a hang.  Progress is any received
+        frame, any commit (duplicates included), or any ledger movement
+        (a co-consumer's commits count); size it above the worst-case
+        stream time of a single split.
     """
 
     def __init__(self, dispatcher_addr, files, job_name="default",
@@ -912,6 +1069,7 @@ class ServiceFeed(object):
         self._sentinel_sent = False
         self._errors = _queue.Queue()
         self._committed = set()     # (epoch, split) commit dedupe
+        self._done_pending = set()  # committed keys whose DONE hasn't landed
         self._commit_lock = threading.Lock()
         self._started = False
         self._streams = {}          # worker_id -> receiver thread
@@ -940,6 +1098,7 @@ class ServiceFeed(object):
     def _maintain(self, client):
         """Roster tracking + completion detection (daemon thread)."""
         off_bound = None  # OFF mode: the worker set frozen at binding time
+        last_sig = None   # last observed ledger-progress signature
         try:
             while not self._stop.is_set():
                 try:
@@ -967,6 +1126,7 @@ class ServiceFeed(object):
                                 daemon=True)
                             self._streams[worker_id] = t
                             t.start()
+                self._flush_pending_done(client)
                 # completion: ledger modes ask the dispatcher; OFF is purely
                 # per-stream (all bound streams finished)
                 if self.mode == SHARD_OFF:
@@ -976,11 +1136,27 @@ class ServiceFeed(object):
                             and all(not t.is_alive() for t in threads)):
                         break
                 else:
+                    status = None
                     try:
-                        if client.status(self.job_name).get("done"):
-                            break
+                        status = client.status(self.job_name)
                     except (DispatchError, OSError, EOFError, TimeoutError):
                         pass
+                    if status is not None:
+                        if status.get("error"):
+                            raise DispatchError(
+                                "data service job {!r} failed: {}".format(
+                                    self.job_name, status["error"]))
+                        if status.get("done"):
+                            break
+                        # any ledger movement is progress: a co-consumer's
+                        # commits keep this (possibly idle) consumer's
+                        # watchdog quiet while the shared job advances
+                        sig = (status.get("epoch"), status.get("completed"),
+                               status.get("assigned"), status.get("pending"),
+                               status.get("reassigned"))
+                        if sig != last_sig:
+                            last_sig = sig
+                            self._last_progress = time.monotonic()
                 if (time.monotonic() - self._last_progress) > self.timeout:
                     raise TimeoutError(
                         "data service made no progress for {}s (job {!r}, "
@@ -988,22 +1164,74 @@ class ServiceFeed(object):
                                                       self.job_name,
                                                       len(roster)))
                 time.sleep(0.1)
-            # job complete: receiver threads exit on their stream_end; give
-            # a zombie stream a short grace, then force its socket closed —
-            # everything it still carries is a duplicate by construction
-            deadline = time.monotonic() + 2.0
-            with self._stream_lock:
-                threads = dict(self._streams)
-            for worker_id, t in threads.items():
-                t.join(timeout=max(0.0, deadline - time.monotonic()))
-                if t.is_alive():
-                    self._close_stream(worker_id)
-                    t.join(timeout=1.0)
+            self._finish_streams()
         except Exception as e:
             self._errors.put(e)
+            # error/terminate path only: delivery is already forfeit, so the
+            # sentinel may evict queued chunks to land immediately
+            self._publish(_SENTINEL, force=True)
+        else:
+            # normal completion: every committed chunk is already queued
+            # (publish precedes DONE), so the sentinel queues BEHIND them —
+            # a slow-draining consumer keeps its tail
+            self._publish(_SENTINEL)
         finally:
             client.close()
-            self._publish(_SENTINEL, force=True)
+
+    def _finish_streams(self):
+        """Post-completion receiver wind-down — without dropping data.
+
+        At job completion every committed chunk is already in the queue
+        (``_commit_split`` publishes before DONE), so receivers are only
+        waiting on their ``stream_end`` — or stuck in ``recv`` on a zombie
+        stream whose remaining frames are duplicates by construction.
+        Give them a short grace to exit cleanly, EOF the stragglers by
+        closing their sockets, then join for as long as the consumer is
+        alive; the chunk queue is never touched."""
+        deadline = time.monotonic() + 2.0
+        with self._stream_lock:
+            threads = dict(self._streams)
+        for worker_id, t in threads.items():
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if t.is_alive():
+                self._close_stream(worker_id)
+        for t in threads.values():
+            while t.is_alive() and not self._stop.is_set():
+                t.join(timeout=0.2)
+
+    def _flush_pending_done(self, client):
+        """Retry parked DONE reports (maintainer tick; ``DONE`` is
+        idempotent, so at-least-once delivery is safe)."""
+        with self._commit_lock:
+            pend = list(self._done_pending)
+        for key in pend:
+            try:
+                client.done_split(self.job_name, key[0], key[1],
+                                  self.consumer_id)
+            except DispatchError as e:
+                # a non-transient refusal (job vanished): drop the report
+                logger.warning("servicefeed: parked DONE for split %s "
+                               "refused (%s)", key, e)
+            except (OSError, EOFError, TimeoutError) as e:
+                logger.warning("servicefeed: parked DONE for split %s still "
+                               "failing (%s)", key, e)
+                return
+            with self._commit_lock:
+                self._done_pending.discard(key)
+
+    def _report_lost_split(self, worker_id, key):
+        """Best-effort LOST report: re-pools the mid-flight split now; the
+        worker-fence path remains the backstop if this fails."""
+        try:
+            client = DispatcherClient(self.dispatcher_addr)
+            try:
+                client.lost_split(self.job_name, key[0], key[1], worker_id,
+                                  self.consumer_id)
+            finally:
+                client.close()
+        except Exception as e:
+            logger.warning("servicefeed: LOST report for split %s on %s "
+                           "failed (%s)", key, worker_id, e)
 
     def _close_stream(self, worker_id):
         with self._stream_lock:
@@ -1026,6 +1254,7 @@ class ServiceFeed(object):
         sock = None
         cur = None       # (epoch, split) being buffered
         pending = []     # buffered chunks of the current split
+        retry = False    # lost after a good dial: let the maintainer redial
         try:
             try:
                 with tracer.span("dataservice/connect", worker_id=worker_id):
@@ -1051,6 +1280,9 @@ class ServiceFeed(object):
             self._last_progress = time.monotonic()
             while not self._stop.is_set():
                 kind, payload = _recv_frame(sock)
+                # byte-level progress: a single split streaming longer than
+                # the watchdog timeout must not trip it while frames flow
+                self._last_progress = time.monotonic()
                 if kind == _K_JSON:
                     msg = json.loads(payload)
                     mtype = msg.get("type")
@@ -1060,6 +1292,18 @@ class ServiceFeed(object):
                     elif mtype == "split_end":
                         self._commit_split(
                             (int(msg["epoch"]), int(msg["split"])), pending)
+                        cur, pending = None, []
+                    elif mtype == "split_abort":
+                        # worker-side reader fault: the stream is healthy
+                        # but this split's buffer is incomplete — drop it;
+                        # the dispatcher re-pools it or fails the job
+                        self.splits_discarded += 1
+                        tracer.instant("dataservice/split_abort",
+                                       worker_id=worker_id,
+                                       split=msg.get("split"))
+                        logger.warning(
+                            "servicefeed: worker %s aborted split %s (%s)",
+                            worker_id, msg.get("split"), msg.get("error"))
                         cur, pending = None, []
                     elif mtype == "stream_end":
                         return
@@ -1072,13 +1316,17 @@ class ServiceFeed(object):
         except (EOFError, OSError) as e:
             if self._stop.is_set():
                 return
+            retry = True
             if cur is not None or pending:
-                # worker died mid-split: the split was never committed, the
-                # dispatcher will re-pool it — drop the partial buffer
+                # stream died mid-split: never committed — drop the partial
+                # buffer and re-pool it NOW via a LOST report (the worker
+                # may be perfectly alive; the fence is only the backstop)
                 self.splits_discarded += 1
                 tracer.instant("dataservice/split_discard",
                                worker_id=worker_id,
                                split=cur[1] if cur else None)
+                if cur is not None and self.mode != SHARD_OFF:
+                    self._report_lost_split(worker_id, cur)
             logger.warning("servicefeed: stream to worker %s lost (%s)",
                            worker_id, e)
         except DispatchError as e:
@@ -1089,12 +1337,20 @@ class ServiceFeed(object):
                 self._errors.put(e)
         finally:
             if sock is not None:
-                with self._stream_lock:
-                    self._stream_socks.pop(worker_id, None)
                 try:
                     sock.close()
                 except OSError:
                     pass
+            with self._stream_lock:
+                self._stream_socks.pop(worker_id, None)
+                if retry and not self._stop.is_set():
+                    # un-claim the stream slot so the maintainer redials the
+                    # still-live worker (bounded by the same dial budget); a
+                    # worker that actually died stops being dialable and
+                    # burns out the budget harmlessly
+                    self._dial_failures[worker_id] = (
+                        self._dial_failures.get(worker_id, 0) + 1)
+                    self._streams.pop(worker_id, None)
 
     def _decode(self, kind, payload):
         if kind == _K_COLV1:
@@ -1114,21 +1370,23 @@ class ServiceFeed(object):
         return chunk
 
     def _commit_split(self, key, chunks):
-        """Exactly-once commit: dedupe, ledger DONE, then publish."""
+        """Exactly-once commit: publish once, report ``DONE`` at-least-once.
+
+        The publish happens exactly once per ``(epoch, split)`` (the
+        ``_committed`` dedupe drops a re-streamed copy whole), and only
+        THEN is ``DONE`` reported — so the dispatcher can never declare
+        the job done while committed chunks are still unpublished.  A
+        failed ``DONE`` (transient dispatcher unreachability) parks the
+        key in ``_done_pending`` for the maintainer to retry each tick:
+        the published data is kept, the ledger catches up when the
+        control plane returns, and a duplicate copy streamed meanwhile is
+        dropped by the dedupe as usual."""
         with self._commit_lock:
             if key in self._committed:
                 self.split_dupes += 1
+                self._last_progress = time.monotonic()
                 return
             self._committed.add(key)
-        # ledger first: once DONE lands the split can never be reassigned,
-        # and the chunks below are already safely buffered in this process
-        client = self.retry_policy.call(
-            lambda: DispatcherClient(self.dispatcher_addr))
-        try:
-            client.done_split(self.job_name, key[0], key[1],
-                              self.consumer_id)
-        finally:
-            client.close()
         for chunk in chunks:
             self._publish(chunk)
         self.splits_committed += 1
@@ -1136,6 +1394,19 @@ class ServiceFeed(object):
         telemetry.get_tracer().instant(
             "dataservice/split_commit", split=key[1], epoch=key[0],
             consumer=self.consumer_id)
+        try:
+            client = self.retry_policy.call(
+                lambda: DispatcherClient(self.dispatcher_addr))
+            try:
+                client.done_split(self.job_name, key[0], key[1],
+                                  self.consumer_id)
+            finally:
+                client.close()
+        except (DispatchError, OSError, EOFError, TimeoutError) as e:
+            with self._commit_lock:
+                self._done_pending.add(key)
+            logger.warning("servicefeed: DONE for split %s failed (%s); "
+                           "parked for maintainer retry", key, e)
 
     def _publish(self, item, force=False):
         if item is _SENTINEL:

@@ -242,9 +242,221 @@ def test_dynamic_worker_killed_mid_epoch_exactly_once(tmp_path):
             assert sorted(got) == sorted(rows)
             status = DispatcherClient(svc.addr).status("kill")
             assert status["done"]
-            assert status["dead_workers"] == 1
+            # the consumer's LOST report re-pools the mid-flight split
+            # immediately, so the job may finish BEFORE the heartbeat fence
+            # lands; the fence must still fire for the silent worker
+            deadline = time.monotonic() + 5
+            while "w0" not in svc.dispatcher.dead_workers():
+                assert time.monotonic() < deadline, "worker never fenced"
+                time.sleep(0.05)
             snap = feed.counters_snapshot()
             assert snap["dataservice_split_dupes"] == 0
+        finally:
+            feed.terminate()
+
+
+# ---------------------------------------------------------------------------
+# Review fixes: completion drain, watchdog progress, stream loss, DONE retry,
+# reader faults
+# ---------------------------------------------------------------------------
+
+def test_lost_split_repools_for_same_consumer():
+    """A consumer's LOST report re-pools the mid-flight split immediately
+    (no fence wait), bound to the same consumer; duplicates are stale."""
+    disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1")
+    addr = disp.start()
+    try:
+        client = DispatcherClient(addr)
+        client.register_worker("w", "127.0.0.1", 1)
+        client.register_job("j", ["s0", "s1"])
+        assert client.request_task("j", "w", "c")["splits"] == [[0, "s0"]]
+        resp = client.lost_split("j", 0, 0, "w", "c")
+        assert resp["ok"] and not resp.get("stale")
+        status = client.status("j")
+        assert status["pending"] == 1 and status["reassigned"] == 1
+        # duplicate report, and a report naming the wrong worker: stale
+        assert client.lost_split("j", 0, 0, "w", "c").get("stale")
+        # the (still-live) worker may re-win the re-pooled split
+        assert client.request_task("j", "w", "c")["splits"] == [[0, "s0"]]
+        assert client.lost_split("j", 0, 0, "other", "c").get("stale")
+        client.close()
+    finally:
+        disp.stop()
+
+
+def test_commit_survives_transient_done_failure(monkeypatch):
+    """A failed DONE report must not drop the published chunks nor wedge
+    the split: the data stays committed (published exactly once), the DONE
+    parks and the maintainer-side flush retries it until the ledger hears
+    it."""
+    from tensorflowonspark_tpu import marker
+
+    disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1")
+    addr = disp.start()
+    try:
+        client = DispatcherClient(addr)
+        client.register_worker("w", "127.0.0.1", 1)
+        client.register_job("j", ["s0"])
+        client.request_task("j", "w", "c")
+        feed = ServiceFeed(addr, ["s0"], job_name="j", consumer_id="c")
+        calls = {"n": 0}
+        real = DispatcherClient.done_split
+
+        def flaky(self, *args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("transient dispatcher outage")
+            return real(self, *args, **kwargs)
+
+        monkeypatch.setattr(DispatcherClient, "done_split", flaky)
+        chunk = marker.Chunk([1, 2, 3])
+        feed._commit_split((0, 0), [chunk])
+        # published despite the failed DONE, and parked for retry
+        assert feed._chunks.qsize() == 1
+        assert (0, 0) in feed._committed
+        assert (0, 0) in feed._done_pending
+        assert not client.status("j")["done"]
+        # a re-streamed duplicate copy is dropped, not re-published
+        feed._commit_split((0, 0), [chunk])
+        assert feed._chunks.qsize() == 1 and feed.split_dupes == 1
+        # the maintainer's flush lands the parked DONE
+        feed._flush_pending_done(client)
+        assert not feed._done_pending
+        assert client.status("j")["done"]
+        client.close()
+    finally:
+        disp.stop()
+
+
+def test_duplicate_commit_counts_as_watchdog_progress():
+    """OFF-mode epoch>=2 replays commit duplicates; the watchdog must see
+    them as progress, not as a stall."""
+    feed = ServiceFeed(("127.0.0.1", 9), ["s0"], job_name="x")
+    feed._committed.add((0, 0))
+    feed._last_progress = 0.0
+    feed._commit_split((0, 0), [])  # duplicate: no dispatcher dial needed
+    assert feed._last_progress > 0.0
+    assert feed.split_dupes == 1
+
+
+def test_slow_single_split_does_not_trip_watchdog(tmp_path):
+    """One split that streams LONGER than the watchdog timeout must not
+    raise: every received frame is progress (frames arrive per 256-row
+    reader block while the split is still uncommitted)."""
+    splits, rows = _write_jsonl(tmp_path, 1, 900)
+
+    def slow_rows(path):
+        for row in data.jsonl_rows(path):
+            time.sleep(0.004)  # ~3.6s total stream, frames every ~1s
+            yield row
+
+    disp = DispatcherServer(heartbeat_interval=0.5, host="127.0.0.1")
+    addr = disp.start()
+    worker = FeedWorker(addr, row_reader=slow_rows, worker_id="slow",
+                        heartbeat_interval=0.5).start()
+    try:
+        feed = ServiceFeed(addr, splits, job_name="slowsplit",
+                           mode=SHARD_DYNAMIC, timeout=2.0)
+        try:
+            got = _drain(feed, timeout=30.0)
+            assert sorted(got) == sorted(rows)
+        finally:
+            feed.terminate()
+    finally:
+        worker.stop()
+        disp.stop()
+
+
+@pytest.mark.chaos(timeout=60)
+def test_stream_loss_recovers_without_worker_death(tmp_path):
+    """A TCP reset after a successful dial must not hang the job: the
+    consumer reports the mid-flight split LOST (immediate re-pool) and the
+    maintainer redials the still-live worker.  Heartbeats here are so slow
+    the fence can never be the rescuer."""
+    import socket as socket_mod
+
+    splits, rows = _write_jsonl(tmp_path, 6, 50)
+
+    def slowish_rows(path):
+        for row in data.jsonl_rows(path):
+            time.sleep(0.002)
+            yield row
+
+    disp = DispatcherServer(heartbeat_interval=60.0, heartbeat_misses=100,
+                            host="127.0.0.1")
+    addr = disp.start()
+    worker = FeedWorker(addr, row_reader=slowish_rows, worker_id="reset",
+                        heartbeat_interval=60.0).start()
+    try:
+        feed = ServiceFeed(addr, splits, job_name="reset",
+                           mode=SHARD_DYNAMIC, timeout=20.0)
+
+        def breaker():
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if feed.splits_committed >= 1:
+                    with feed._stream_lock:
+                        socks = list(feed._stream_socks.values())
+                    for s in socks:
+                        try:
+                            s.shutdown(socket_mod.SHUT_RDWR)
+                        except OSError:
+                            pass
+                    return
+                time.sleep(0.002)
+
+        bt = threading.Thread(target=breaker, daemon=True)
+        bt.start()
+        try:
+            got = _drain(feed, timeout=40.0)
+            bt.join(timeout=5)
+            assert sorted(got) == sorted(rows)
+            assert feed.split_dupes == 0
+        finally:
+            feed.terminate()
+    finally:
+        worker.stop()
+        disp.stop()
+
+
+def test_reader_fault_fails_job_with_cause(tmp_path):
+    """An unreadable split surfaces the reader's error to the consumer
+    (split_abort in-band + SPLIT_ERR -> re-pool budget -> job failure)
+    instead of wedging into an opaque watchdog timeout."""
+    splits, _ = _write_jsonl(tmp_path, 2, 10)
+    splits.append(os.path.join(str(tmp_path), "missing.jsonl"))
+    with _Service(n_workers=2) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="bad",
+                           mode=SHARD_DYNAMIC, timeout=20.0)
+        try:
+            with pytest.raises(DispatchError, match="missing"):
+                _drain(feed, timeout=30.0)
+            assert feed.splits_discarded >= 1
+        finally:
+            feed.terminate()
+        status = DispatcherClient(svc.addr).status("bad")
+        assert status["error"] and "missing.jsonl" in status["error"]
+        assert not status["done"]
+
+
+def test_slow_consumer_drains_tail_after_job_done(tmp_path):
+    """End-of-job must not evict queued chunks: a consumer draining much
+    slower than the maintainer's completion detection still receives every
+    element (the sentinel queues BEHIND committed data, never over it)."""
+    splits, rows = _write_jsonl(tmp_path, 8, 256)  # 1 reader block each
+    with _Service(n_workers=2) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="slowdrain",
+                           mode=SHARD_DYNAMIC, prefetch=2, timeout=20.0)
+        got = []
+        deadline = time.monotonic() + 60
+        try:
+            while not feed.should_stop():
+                assert time.monotonic() < deadline, "feed did not complete"
+                arrays, count = feed.next_batch_arrays(64)
+                if count:
+                    got.extend(arrays.tolist())
+                time.sleep(0.15)  # job completes long before the drain does
+            assert sorted(got) == sorted(rows)
         finally:
             feed.terminate()
 

@@ -28,12 +28,15 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 
 # the driver gates: compile-check the graft entry + the multi-chip dry run,
 # prove the elastic-recovery loop closes on a real 3-node cluster, prove
-# the telemetry plane produces parseable traces + HBEAT counters, then
-# prove the data service keeps its exactly-once guarantee through a
-# worker SIGKILL (dispatcher + 2 worker subprocesses + 2 consumers)
+# the telemetry plane produces parseable traces + HBEAT counters, prove
+# the data service keeps its exactly-once guarantee through a worker
+# SIGKILL (dispatcher + 2 worker subprocesses + 2 consumers), then prove
+# the step loop overlaps: guard-clean device-resident dispatches, async
+# checkpoint saves, and dispatch-gap counters reaching the driver
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
 python scripts/ci_assert_dataservice.py
+python scripts/ci_assert_overlap.py
 
 exit $rc

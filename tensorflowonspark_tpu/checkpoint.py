@@ -21,11 +21,21 @@ This module implements those conventions over orbax:
 import json
 import logging
 import os
+import queue as _queue
+import threading
 
 logger = logging.getLogger(__name__)
 
 _DESCRIPTOR = "export.json"
 _PARAMS_DIR = "params"
+
+#: async ``maybe_save`` toggle (default ON): "0"/"off" forces the legacy
+#: synchronous save, where maybe_save blocks the dispatch loop for the full
+#: serialization+write.  See :class:`CheckpointManager`.
+ASYNC_CKPT_ENV = "TFOS_ASYNC_CKPT"
+
+#: how long :meth:`CheckpointManager.close` waits for the async worker
+_CLOSE_JOIN_SECS = 120.0
 
 
 def _fs_path(path):
@@ -55,10 +65,26 @@ class CheckpointManager(object):
         and enter a sync barrier), so gating the *call* on chiefness would
         deadlock multi-host runs.  The reference's chief-only pattern
         applies to the single-file export path, not here.
+      async_save: ``True`` (the default; ``None`` reads ``TFOS_ASYNC_CKPT``)
+        makes :meth:`maybe_save` return as soon as the state is snapshotted
+        to fresh device buffers and handed to a background worker thread —
+        the orbax serialization + write overlap the next dispatches instead
+        of stalling the step loop.  The snapshot is **donation-safe**: a
+        jitted device-side copy of every ``jax.Array`` leaf, so the very
+        next train step may donate the live state without garbling the save
+        in flight.  At most one save is queued and one in flight (a
+        ``Queue(maxsize=1)`` blocking put is the backpressure: a third save
+        request waits, bounding extra state copies to two).  All read paths
+        (:meth:`restore_latest`, :meth:`restore_latest_valid`,
+        :meth:`latest_step`, :meth:`wait_until_finished`, :meth:`close`)
+        drain pending saves first, and a worker failure surfaces on the
+        next :meth:`maybe_save` or :meth:`wait_until_finished` — a save is
+        never silently lost.  ``False`` restores the legacy synchronous
+        behavior.
     """
 
     def __init__(self, directory, save_interval_steps=100, max_to_keep=3,
-                 is_chief=True):
+                 is_chief=True, async_save=None):
         import orbax.checkpoint as ocp
 
         self.directory = _fs_path(directory)
@@ -72,15 +98,35 @@ class CheckpointManager(object):
             ),
         )
         self.save_interval_steps = save_interval_steps
+        if async_save is None:
+            async_save = os.environ.get(ASYNC_CKPT_ENV, "1").lower() not in (
+                "0", "off", "false", "")
+        self.async_save = bool(async_save)
+        self._save_queue = _queue.Queue(maxsize=1)
+        self._save_thread = None      # started lazily on first async save
+        self._save_error = None       # worker exception, re-raised at a sync
+        self._last_requested = None   # newest step handed to the worker
+        self._copy_fn = None          # cached jitted device-side leaf copy
         # Resolved once: the corrupt_checkpoint fault fires ONCE per process,
         # and a fresh from_env() per save would re-arm it every time.
         from tensorflowonspark_tpu import fault
 
         self._injector = fault.from_env()
 
+    def _latest_effective(self):
+        """Newest step saved OR handed to the async worker: the save gates
+        must be computed against requested steps, not just landed ones —
+        orbax's ``latest_step`` lags while a save is in flight, and gating
+        on it alone would enqueue the same boundary twice."""
+        latest = self._mgr.latest_step()
+        if self._last_requested is not None and (
+                latest is None or self._last_requested > latest):
+            return self._last_requested
+        return latest
+
     def maybe_save(self, step, state, force=False):
         """Save if an interval boundary was CROSSED since the last save;
-        returns True if saved.
+        returns True if a save landed (sync) or was accepted (async).
 
         Boundary-crossing (not ``step % interval == 0``): callers that see
         steps at a stride — ``fit_feed(steps_per_call=K)`` reports once per
@@ -88,19 +134,36 @@ class CheckpointManager(object):
         otherwise save never (misaligned residues) or at lcm(K, interval).
 
         Must be called by ALL hosts each step (collective; see class doc) —
-        the check below is deterministic so hosts agree."""
+        the check below is deterministic so hosts agree.  Async mode keeps
+        that determinism: the gate decides at enqueue time from locally-
+        tracked request state, the snapshot is taken synchronously (device-
+        side copy — cheap), and only the orbax serialization/write moves to
+        the worker, in strict request order on every host."""
+        self._raise_pending_error()
         if not force:
             if not self.save_interval_steps:
                 return False  # interval 0: explicit (force=True) saves only
-            last = self._mgr.latest_step() or 0
+            last = self._latest_effective() or 0
             if (step // self.save_interval_steps
                     <= last // self.save_interval_steps):
                 return False
-        if step == self._mgr.latest_step():
+        if step == self._latest_effective():
             return False  # already saved (e.g. final force after interval hit)
         import orbax.checkpoint as ocp
 
         from tensorflowonspark_tpu import telemetry
+
+        if self.async_save:
+            snapshot = self._snapshot_for_save(state)
+            self._ensure_worker()
+            telemetry.get_tracer().instant("checkpoint/save_requested",
+                                           step=step, force=force)
+            # Blocking put is the backpressure: with one save in flight and
+            # one queued, a third request waits here instead of stacking
+            # unbounded state snapshots.
+            self._save_queue.put((step, snapshot, force))
+            self._last_requested = step
+            return True
 
         with telemetry.get_tracer().span("checkpoint/save", step=step,
                                          force=force):
@@ -108,12 +171,96 @@ class CheckpointManager(object):
                 _globalize(state)), force=force)
         if saved:
             logger.info("checkpointed step %d to %s", step, self.directory)
-            if self._injector.enabled:
-                # chaos only: the injector garbles finalized step dirs, so
-                # flush the async save before handing it the directory
-                self._mgr.wait_until_finished()
-                self._injector.corrupt_checkpoint(self.directory)
+            self._maybe_inject_corruption()
         return saved
+
+    # -- async save machinery ---------------------------------------------
+
+    def _raise_pending_error(self):
+        if self._save_error is not None:
+            err, self._save_error = self._save_error, None
+            # Re-derive the request watermark from what actually landed, so
+            # a retry after the failure can save the same step again.
+            self._last_requested = self._mgr.latest_step()
+            raise err
+
+    def _snapshot_for_save(self, state):
+        """Donation-safe snapshot: fresh device-side copies of every
+        ``jax.Array`` leaf (jitted — legal on multi-host global arrays,
+        where eager copies are rejected; PJRT orders the copy before any
+        later donation of the originals), ``np.copy`` for host arrays.
+        Cached single compilation — the state structure is fixed."""
+        import jax
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        device_ix = [i for i, l in enumerate(leaves)
+                     if isinstance(l, jax.Array)]
+        if device_ix:
+            if self._copy_fn is None:
+                import jax.numpy as jnp
+
+                self._copy_fn = jax.jit(
+                    lambda xs: [jnp.copy(x) for x in xs])
+            copies = self._copy_fn([leaves[i] for i in device_ix])
+            for i, c in zip(device_ix, copies):
+                leaves[i] = c
+        for i, l in enumerate(leaves):
+            if isinstance(l, np.ndarray):
+                leaves[i] = np.copy(l)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _ensure_worker(self):
+        if self._save_thread is not None and self._save_thread.is_alive():
+            return
+        t = threading.Thread(target=self._save_worker, name="ckpt-async-save",
+                             daemon=True)
+        self._save_thread = t
+        t.start()
+
+    def _save_worker(self):
+        import orbax.checkpoint as ocp
+
+        from tensorflowonspark_tpu import telemetry
+
+        while True:
+            item = self._save_queue.get()
+            try:
+                if item is None:
+                    return
+                step, state, force = item
+                # force=True always: the maybe_save gate IS the policy and
+                # already passed at enqueue time; orbax's own interval check
+                # (which disagrees with boundary-crossing at step strides)
+                # must not silently drop an accepted save.
+                with telemetry.get_tracer().span(
+                        "checkpoint/save", step=step, force=force,
+                        asynchronous=True):
+                    self._mgr.save(step, args=ocp.args.StandardSave(
+                        _globalize(state)), force=True)
+                logger.info("checkpointed step %d to %s (async)", step,
+                            self.directory)
+                self._maybe_inject_corruption()
+            except BaseException as e:  # surfaced at the next sync point
+                logger.exception("async checkpoint save of step %s failed",
+                                 item[0] if item else "?")
+                self._save_error = e
+            finally:
+                self._save_queue.task_done()
+
+    def _maybe_inject_corruption(self):
+        if self._injector.enabled:
+            # chaos only: the injector garbles finalized step dirs, so
+            # flush the async save before handing it the directory
+            self._mgr.wait_until_finished()
+            self._injector.corrupt_checkpoint(self.directory)
+
+    def _drain_pending(self):
+        """Block until every queued async save has been handed to orbax
+        (the orbax-internal async commit is flushed separately by
+        ``_mgr.wait_until_finished``)."""
+        if self._save_thread is not None and self._save_thread.is_alive():
+            self._save_queue.join()
 
     def restore_latest(self, abstract_state):
         """Restore the newest checkpoint into the structure of
@@ -123,6 +270,8 @@ class CheckpointManager(object):
         manager creation, and the callers of this method (recovery after
         restart, a polling evaluator node) are exactly the ones racing
         another process's writes."""
+        self._drain_pending()
+        self._mgr.wait_until_finished()
         self._mgr.reload()
         step = self._mgr.latest_step()
         if step is None:
@@ -156,6 +305,8 @@ class CheckpointManager(object):
 
         from tensorflowonspark_tpu import telemetry
 
+        self._drain_pending()
+        self._mgr.wait_until_finished()
         tracer = telemetry.get_tracer()
         tried = set()
         while True:
@@ -214,15 +365,38 @@ class CheckpointManager(object):
     def latest_step(self, reload=True):
         """Newest saved step, or None.  ``reload=True`` re-reads the step
         list from storage (orbax caches it), so polling evaluators can
-        probe for new checkpoints cheaply without a full restore."""
+        probe for new checkpoints cheaply without a full restore.  Pending
+        async saves are flushed first, so "latest" includes every accepted
+        :meth:`maybe_save`."""
+        self._drain_pending()
+        self._mgr.wait_until_finished()
         if reload:
             self._mgr.reload()
         return self._mgr.latest_step()
 
     def wait_until_finished(self):
+        """Barrier: every accepted save is durably on storage when this
+        returns, and a failed async save raises here instead of vanishing.
+        Called on all exit paths (end-of-fit, preemption drain, emergency
+        save) — see :func:`~tensorflowonspark_tpu.train.fit_supervised`."""
+        self._drain_pending()
         self._mgr.wait_until_finished()
+        self._raise_pending_error()
 
     def close(self):
+        """Flush pending saves, stop the async worker, close orbax.  Never
+        raises for a failed in-flight save (close runs on unwind paths);
+        the failure is logged by the worker."""
+        if self._save_thread is not None and self._save_thread.is_alive():
+            try:
+                self._save_queue.join()
+            except Exception:  # pragma: no cover - defensive
+                pass
+            self._save_queue.put(None)  # shutdown sentinel
+            self._save_thread.join(timeout=_CLOSE_JOIN_SECS)
+            if self._save_thread.is_alive():  # pragma: no cover - wedged fs
+                logger.error("async checkpoint worker did not exit within "
+                             "%.0fs; abandoning it", _CLOSE_JOIN_SECS)
         self._mgr.close()
 
 

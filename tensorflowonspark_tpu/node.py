@@ -54,14 +54,17 @@ _JAX_JOBS = ("chief", "master", "worker")
 # garbage collected, and the node must outlive the start task in SPARK mode.
 _node_state = {}
 
-# Live DataFeed instances in THIS process (weakrefs; populated by
-# TPUNodeContext.get_data_feed).  The heartbeat metrics provider snapshots
-# them so HBEAT payloads carry feed-plane counters without the feed having
-# to know about telemetry.
+# Live per-process metrics sources (weakrefs): anything with a flat
+# ``counters_snapshot() -> dict`` — DataFeeds (TPUNodeContext.get_data_feed),
+# ShardedFeeds (infeed overlap tallies), Trainers (dispatch-gap tallies).
+# The heartbeat metrics provider snapshots them so HBEAT payloads carry the
+# counters without the source having to know about telemetry.
 _feeds = []
 
 
 def _register_feed(feed):
+    """Register a metrics source for this node's heartbeats (weakref; dead
+    sources are pruned on the next snapshot)."""
     _feeds.append(weakref.ref(feed))
 
 

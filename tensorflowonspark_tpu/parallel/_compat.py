@@ -1,0 +1,34 @@
+"""jax version compatibility for the shard_map kernels.
+
+The parallel kernels (ring/ulysses attention, GPipe, explicit-EP MoE) are
+written against the modern spelling — top-level ``jax.shard_map`` with the
+varying-manual-axes type system (``check_vma``, ``jax.lax.pcast``) — but
+must still import and run on jax releases where shard_map lives in
+``jax.experimental.shard_map`` and replication checking is the older
+``check_rep`` pass.  That pass mis-flags the ppermute/all_to_all carries
+these kernels build, so it is disabled on the fallback path; the numerics
+tests (kernels vs reference attention / sequential / dense-GSPMD) hold
+either way, which is the check that actually matters.
+"""
+
+import jax
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` where available, else the experimental spelling
+    with ``check_vma`` translated away (see module docstring)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
+def pcast_varying(x, axes):
+    """``jax.lax.pcast(x, axes, to="varying")`` where it exists; identity on
+    older jax, whose shard_map has no varying-axes type to cast into."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None or not axes:
+        return x
+    return pcast(x, axes, to="varying")

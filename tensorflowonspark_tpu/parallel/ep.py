@@ -113,8 +113,9 @@ def moe_ffn(x, params, mesh, num_experts, capacity_factor=1.25,
     import jax
     import jax.numpy as jnp
     from jax import lax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from tensorflowonspark_tpu.parallel._compat import shard_map
 
     if batch_axes is None:
         batch_axes = (axis,)

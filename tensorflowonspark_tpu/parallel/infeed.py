@@ -15,18 +15,40 @@ caps accelerator utilization.  Here each host:
    full step's worth of data exists — replacing the reference's fragile
    "90% of steps" workaround (``mnist_spark.py:58-66``) with an exact
    end-of-data barrier (SURVEY §7.4.1),
-4. optionally double-buffers (prefetch) so host assembly overlaps device step.
+4. double-buffers by default (prefetch) so host assembly AND the
+   host->device transfer overlap the device step: the dispatch loop only
+   ever sees already-device-resident, freshly-allocated (donation-safe)
+   arrays, and never blocks on PCIe/transport.  The overlap is measured,
+   not assumed: always-on ``infeed_assembly_us`` / ``infeed_put_us``
+   counters (+ ``_hwm``) ride heartbeats into the driver's
+   ``metrics_snapshot()``, and ``infeed/assemble`` / ``infeed/device_put``
+   spans land on the telemetry timeline when tracing is enabled.
 """
 
 import logging
+import os
 import queue as _queue
 import threading
+import time
 
 import numpy as np
 
+from tensorflowonspark_tpu import telemetry
 from tensorflowonspark_tpu.parallel import collectives, mesh as mesh_mod
 
 logger = logging.getLogger(__name__)
+
+#: prefetch depth used when the ctor gets ``prefetch=None`` (device-resident
+#: double buffering by default; 0 disables the prefetch thread entirely and
+#: moves assembly + transfer back onto the dispatch path)
+PREFETCH_ENV = "TFOS_INFEED_PREFETCH"
+DEFAULT_PREFETCH = 2
+
+#: how long :meth:`ShardedFeed.terminate` waits for the prefetch thread — it
+#: can be mid device_put (not interruptible), so the join is bounded, re-
+#: interrupting the feed each round; past the deadline the queue drain is
+#: skipped (single-consumer invariant) and the daemon thread is abandoned.
+TERMINATE_JOIN_SECS = 30.0
 
 _GROUP_SLICER = None
 
@@ -62,7 +84,11 @@ class ShardedFeed(object):
         pair with feeders' ColChunk blocks for the full zero-object plane.
       pad_final: when the feed ends mid-batch, pad the final global batch to
         full size and attach a validity mask instead of dropping the tail.
-      prefetch: number of batches to assemble ahead on a host thread.
+      prefetch: number of batches to assemble ahead on a host thread — each
+        buffered batch is already **device-resident** (the host->device
+        transfer runs on the prefetch thread, not the dispatch path), at a
+        cost of ``prefetch`` extra batches of HBM.  ``None`` reads
+        ``TFOS_INFEED_PREFETCH`` (default 2); 0 disables the thread.
       sharding: optional NamedSharding overriding the default batch
         sharding for data leaves — e.g. ``PartitionSpec(("data",), "seq")``
         to shard LM token batches over the sequence axis too.  The spec is
@@ -71,7 +97,7 @@ class ShardedFeed(object):
     """
 
     def __init__(self, feed, mesh, global_batch_size, preprocess=None,
-                 transform=None, pad_final=True, prefetch=2, sharding=None):
+                 transform=None, pad_final=True, prefetch=None, sharding=None):
         import jax
 
         assert preprocess is None or transform is None, \
@@ -83,7 +109,21 @@ class ShardedFeed(object):
         self.preprocess = preprocess  # None = columnar next_batch_arrays path
         self.transform = transform
         self.pad_final = pad_final
+        if prefetch is None:
+            prefetch = int(os.environ.get(PREFETCH_ENV, "")
+                           or DEFAULT_PREFETCH)
         self._prefetch_depth = prefetch
+        # Always-on plain-int tallies (the DataFeed/shmring pattern —
+        # telemetry reads them at heartbeat cadence, the hot path never
+        # pays for a lock or a tracer call): batches transferred, host
+        # assembly time, and host->device transfer time, with per-batch
+        # high-water marks.  Single writer (the prefetch thread, or the
+        # consumer when prefetch=0); heartbeat reads tolerate staleness.
+        self._n_batches = 0
+        self._assembly_us = 0
+        self._assembly_us_hwm = 0
+        self._put_us = 0
+        self._put_us_hwm = 0
         self._sharding = sharding or mesh_mod.batch_sharding(mesh)
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -93,6 +133,16 @@ class ShardedFeed(object):
         self._num_processes = jax.process_count()
         self._stop = None            # prefetch stop event (set in batches())
         self._prefetch_thread = None
+        # Ride this node's heartbeats: the metrics provider duck-types
+        # counters_snapshot() over every registered source, so the infeed_*
+        # tallies reach the driver's metrics_snapshot() aggregate.  Guarded:
+        # standalone use (no node runtime) must not care.
+        try:
+            from tensorflowonspark_tpu import node as _node_mod
+
+            _node_mod._register_feed(self)
+        except Exception:  # pragma: no cover - import cycles / stripped envs
+            pass
 
     def _leaf_sharding(self, ndim):
         """Data-leaf sharding with the spec truncated to the leaf's rank
@@ -107,9 +157,47 @@ class ShardedFeed(object):
 
     # -- host-side batch assembly ----------------------------------------
 
+    # -- overlap accounting ----------------------------------------------
+
+    def _tally_assembly(self, start):
+        us = int((time.perf_counter() - start) * 1e6)
+        self._assembly_us += us
+        if us > self._assembly_us_hwm:
+            self._assembly_us_hwm = us
+
+    def _tally_put(self, start):
+        us = int((time.perf_counter() - start) * 1e6)
+        self._put_us += us
+        if us > self._put_us_hwm:
+            self._put_us_hwm = us
+
+    def counters_snapshot(self):
+        """Flat infeed overlap counters for heartbeat payloads /
+        :func:`~tensorflowonspark_tpu.telemetry.merge_counters`:
+        ``infeed_batches`` (device transfers), ``infeed_assembly_us`` (host
+        columnar assembly, INCLUDING time blocked on the upstream feed —
+        starvation is separately visible as ``feed_stall_secs``),
+        ``infeed_put_us`` (host->device transfer), and per-batch ``_hwm``
+        high-water marks of both."""
+        return {
+            "infeed_batches": self._n_batches,
+            "infeed_assembly_us": self._assembly_us,
+            "infeed_assembly_us_hwm": self._assembly_us_hwm,
+            "infeed_put_us": self._put_us,
+            "infeed_put_us_hwm": self._put_us_hwm,
+        }
+
     def _next_local(self):
         """Assemble this host's local batch as final columnar arrays;
         returns (arrays, count) or None when no usable rows remain."""
+        start = time.perf_counter()
+        with telemetry.get_tracer().span("infeed/assemble"):
+            local = self._next_local_inner()
+        if local is not None:
+            self._tally_assembly(start)
+        return local
+
+    def _next_local_inner(self):
         if self.preprocess is not None:
             # row-list path: user preprocess consumes the raw item lists
             items = self.feed.next_batch(self.local_batch_size)
@@ -134,7 +222,13 @@ class ShardedFeed(object):
 
     def _shard(self, arrays, count):
         """Pad to the local batch size and transfer to devices as this
-        process's shard of the global batch; returns (batch, mask)."""
+        process's shard of the global batch; returns (batch, mask).
+
+        The transfer is an explicit ``make_array_from_process_local_data``
+        into freshly-allocated device buffers — donation-safe (the step may
+        donate the batch) and legal under a host->device transfer guard on
+        the dispatch path, because when prefetch is on this runs on the
+        prefetch thread."""
         import jax
 
         def to_padded(col):
@@ -153,9 +247,14 @@ class ShardedFeed(object):
             return jax.make_array_from_process_local_data(
                 self._leaf_sharding(np.ndim(x)), x)
 
-        batch = jax.tree_util.tree_map(put, local)
-        return batch, jax.make_array_from_process_local_data(
-            self._mask_sharding, mask)
+        start = time.perf_counter()
+        with telemetry.get_tracer().span("infeed/device_put", rows=count):
+            batch = jax.tree_util.tree_map(put, local)
+            mask = jax.make_array_from_process_local_data(
+                self._mask_sharding, mask)
+        self._tally_put(start)
+        self._n_batches += 1
+        return batch, mask
 
     # -- public iteration -------------------------------------------------
 
@@ -305,13 +404,31 @@ class ShardedFeed(object):
         task_done from two threads can double-ack (spurious ValueError after
         successful training) or desync the ring tail.  Stop the producer,
         interrupt its blocked get, join it — then drain.
+
+        The join is BOUNDED (:data:`TERMINATE_JOIN_SECS`): the producer may
+        be mid ``device_put`` (not interruptible) or racing the interrupt
+        flag (interrupt-then-get windows), so each round re-interrupts the
+        feed and waits briefly instead of a single unbounded join.  If the
+        thread still hasn't exited by the deadline (a wedged backend), the
+        queue drain is skipped — draining concurrently with a live producer
+        would break the single-consumer invariant — and the daemon thread is
+        abandoned with a loud log instead of hanging shutdown forever.
         """
         if self._stop is not None:
             self._stop.set()
         t = self._prefetch_thread
         if t is not None and t.is_alive():
-            self.feed.interrupt()
-            t.join()
+            deadline = time.monotonic() + TERMINATE_JOIN_SECS
+            while t.is_alive() and time.monotonic() < deadline:
+                self.feed.interrupt()
+                t.join(timeout=0.2)
+            if t.is_alive():
+                logger.error(
+                    "infeed prefetch thread did not exit within %.0fs of "
+                    "terminate(); skipping the queue drain (single-consumer "
+                    "invariant) and abandoning the daemon thread",
+                    TERMINATE_JOIN_SECS)
+                return
         self.feed.terminate()
 
     def _local_iter(self):
@@ -379,11 +496,17 @@ class ShardedFeed(object):
             if not singles_mode and count == self.local_batch_size:
                 pending.append(arrays)
                 if len(pending) == k:
-                    stack = jax.tree_util.tree_map(
-                        lambda *cols: put_stack(cols), *pending)
-                    if masks is None:
-                        masks = put_stack(
-                            [np.ones((self.local_batch_size,), np.float32)] * k)
+                    start = time.perf_counter()
+                    with telemetry.get_tracer().span("infeed/device_put",
+                                                     group=k):
+                        stack = jax.tree_util.tree_map(
+                            lambda *cols: put_stack(cols), *pending)
+                        if masks is None:
+                            masks = put_stack(
+                                [np.ones((self.local_batch_size,),
+                                         np.float32)] * k)
+                    self._tally_put(start)
+                    self._n_batches += k
                     pending = []
                     yield ("multi", stack, masks)
                 continue
@@ -433,7 +556,16 @@ class ShardedFeed(object):
         self._prefetch_thread = t
         t.start()
         while True:
-            item = buf.get()
+            # Timed get + producer-liveness check: terminate() from another
+            # thread sets stop and the producer exits WITHOUT its None
+            # sentinel (its pending _put aborts) — a bare blocking get here
+            # would then wait forever on a buffer nobody will ever fill.
+            try:
+                item = buf.get(timeout=0.2)
+            except _queue.Empty:
+                if stop.is_set() and not t.is_alive():
+                    return
+                continue
             if isinstance(item, BaseException):
                 raise item
             yield item

@@ -69,7 +69,7 @@ def gpipe(stage_fn, stacked_params, microbatches, mesh, axis="pipe"):
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    shard_map = jax.shard_map
+    from tensorflowonspark_tpu.parallel._compat import shard_map
 
     n_stages = mesh.shape[axis]
     n_micro = microbatches.shape[0]

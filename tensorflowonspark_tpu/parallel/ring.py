@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tensorflowonspark_tpu.parallel._compat import pcast_varying, shard_map
+
 NEG_INF = -1e30  # large-negative instead of -inf: keeps exp() NaN-free
 
 
@@ -67,7 +69,7 @@ def _ring_shard_fn(q, k, v, axis_name, causal, scale, vary_axes):
     l = jnp.zeros((batch, sq, heads), dtype=jnp.float32)
     # The loop carry must be device-varying-typed from the start (shard_map
     # vma typing): the accumulators are per-shard state.
-    o, m, l = (jax.lax.pcast(x, vary_axes, to="varying") for x in (o, m, l))
+    o, m, l = (pcast_varying(x, vary_axes) for x in (o, m, l))
     q32 = q.astype(jnp.float32)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
@@ -101,8 +103,6 @@ def ring_attention(q, k, v, mesh, seq_axis="seq", batch_axis="data",
 
     Returns an array shaped/sharded like ``q``.
     """
-    from jax import shard_map
-
     assert seq_axis in mesh.axis_names, (
         "mesh {} has no {!r} axis".format(dict(mesh.shape), seq_axis))
     if scale is None:
@@ -161,8 +161,6 @@ def ulysses_attention(q, k, v, mesh, seq_axis="seq", batch_axis="data",
     the full sequence for its slice of heads, with two all_to_alls doing the
     re-sharding.  Same signature/semantics as :func:`ring_attention`.
     """
-    from jax import shard_map
-
     assert q.shape[2] % mesh.shape[seq_axis] == 0, (
         "heads {} not divisible by seq-parallel degree {}".format(
             q.shape[2], mesh.shape[seq_axis]))

@@ -303,15 +303,31 @@ class Server(MessageSocket):
                 and meta.get("executor_id") is not None:
             self._beats[meta["executor_id"]] = (time.monotonic(), meta)
 
+    def _latch_metrics(self, executor_id, metrics):
+        """Fold a piggybacked counter snapshot into the per-executor latch
+        KEY-WISE, not wholesale: node counters are cumulative, so the newest
+        value per key wins, but keys absent from a later payload keep their
+        last-seen value — a metrics source that was garbage collected with
+        the user fn (a feed, a trainer) must not erase the counters it
+        already reported when the final BYE snapshot arrives without it."""
+        if not (isinstance(metrics, dict) and metrics):
+            return
+        prev = self._node_metrics.get(executor_id)
+        if prev:
+            merged = dict(prev)
+            merged.update(metrics)
+            self._node_metrics[executor_id] = merged
+        else:
+            self._node_metrics[executor_id] = metrics
+
     def _beat(self, executor_id, metrics=None):
         """Record a heartbeat; False if the node was already declared dead
         (the sender is fenced: a zombie must not resurrect silently).
         ``metrics`` is an optional piggybacked counter snapshot (flat JSON
-        dict); the latest per executor is kept for :meth:`metrics_snapshot`."""
+        dict); latched per executor for :meth:`metrics_snapshot`."""
         if executor_id in self._dead:
             return False
-        if isinstance(metrics, dict) and metrics:
-            self._node_metrics[executor_id] = metrics
+        self._latch_metrics(executor_id, metrics)
         if executor_id in self._beats:
             self._beats[executor_id] = (
                 time.monotonic(), self._beats[executor_id][1])
@@ -496,9 +512,7 @@ class Server(MessageSocket):
             data = msg.get("data") or {}
             executor_id = data.get("executor_id")
             if executor_id is not None:
-                metrics = data.get("metrics")
-                if isinstance(metrics, dict) and metrics:
-                    self._node_metrics[executor_id] = metrics
+                self._latch_metrics(executor_id, data.get("metrics"))
                 self._forget(executor_id, reason=data.get("reason"))
                 telemetry.get_tracer().instant(
                     "reservation/bye", executor_id=executor_id,

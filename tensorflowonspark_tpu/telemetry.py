@@ -6,9 +6,16 @@ Three legs, all dependency-free:
    ``span(name, **attrs)`` context managers and ``instant`` events, emitting
    Chrome trace-event JSON (loadable in ``chrome://tracing`` / Perfetto).
    One file per process: ``<dir>/trace-<host>-<pid>.json``.
-2. **Counters** — a flat ``str -> number`` map with ``counter_add``; node
-   processes snapshot them into heartbeat payloads (``reservation.py``), the
-   driver aggregates with :func:`merge_counters`.
+2. **Counters** — a flat ``str -> number`` map with ``counter_add`` /
+   ``counter_max``; node processes snapshot them into heartbeat payloads
+   (``reservation.py``), the driver aggregates with :func:`merge_counters`.
+   The step-loop overlap vocabulary rides this leg as always-on plain-int
+   tallies kept by their owners (telemetry only reads them):
+   ``dispatch_count`` / ``dispatch_gap_us`` (+``_hwm``) on the Trainer —
+   host-side time between dispatches — and ``infeed_batches`` /
+   ``infeed_assembly_us`` / ``infeed_put_us`` (+``_hwm``) on the
+   ShardedFeed — host assembly vs host->device transfer time, both off the
+   dispatch path when prefetch is on.
 3. **Hang flight recorder** — :meth:`Tracer.dump` writes all-thread
    stacktraces, the open span stack, counters, and caller-supplied state to
    ``<dir>/flight-<host>-<pid>.json``; triggered by SIGUSR1
@@ -110,6 +117,9 @@ class _NullTracer(object):
         pass
 
     def counter_add(self, name, delta=1):
+        pass
+
+    def counter_max(self, name, value):
         pass
 
     def counters_snapshot(self):

@@ -9,8 +9,10 @@ allreduced implicitly by sharded batch + replicated params), the metrics
 from Spark partitions.
 """
 
+import contextlib
 import dataclasses
 import logging
+import os
 import time
 from typing import Any, Callable, Optional
 
@@ -21,6 +23,38 @@ from tensorflowonspark_tpu import metrics as metrics_mod
 from tensorflowonspark_tpu.parallel import mesh as mesh_mod
 
 logger = logging.getLogger(__name__)
+
+#: opt-in hot-loop transfer guard (see :func:`_resolve_transfer_guard`):
+#: "1"/"on"/"disallow" makes any implicit host->device transfer inside a
+#: fit_feed dispatch a hard error; "log" logs instead; ""/"0"/"off"/"allow"
+#: disables (the default — guards cost a context switch per dispatch).
+TRANSFER_GUARD_ENV = "TFOS_TRANSFER_GUARD"
+
+
+def _resolve_transfer_guard(mode):
+    """Normalize a ``fit_feed(transfer_guard=...)`` / env value to a jax
+    transfer-guard level string, or None when guarding is off.
+
+    Only the **host->device** direction is guarded: the dispatch path must
+    never re-transfer batch data (that is the infeed prefetch thread's job),
+    but the metrics recorder legitimately syncs the loss device->host at
+    window boundaries — a full ``jax.transfer_guard`` would flag it.
+    """
+    if mode is None:
+        mode = os.environ.get(TRANSFER_GUARD_ENV, "")
+    if not mode or mode in ("0", "off", "allow", "allow_explicit", False):
+        return None
+    if mode in ("1", "on", True):
+        return "disallow"
+    return mode  # "disallow" / "log" / "log_explicit" pass through
+
+
+def _transfer_guard_ctx(level):
+    """Fresh guard context per dispatch (jax's config contexts are
+    contextmanager-based generators — not re-enterable)."""
+    if level is None:
+        return contextlib.nullcontext()
+    return jax.transfer_guard_host_to_device(level)
 
 
 @dataclasses.dataclass
@@ -250,6 +284,27 @@ class Trainer(object):
         self._multi_cache = {}  # k -> jitted k-step scan program
         self._eval_cache = {}   # metric_fn -> jitted wrapper (evaluate)
         self.history = None
+        # Always-on dispatch-overlap tallies (plain ints, the DataFeed
+        # pattern): the host-side gap between a dispatch returning and the
+        # next one starting — the serial section the device-resident infeed
+        # + async checkpointing exist to shrink.  Written by the fit_feed
+        # loop only; heartbeat reads tolerate staleness.
+        self._dispatch_count = 0
+        self._dispatch_gap_us = 0
+        self._dispatch_gap_us_hwm = 0
+
+    def counters_snapshot(self):
+        """Flat overlap counters for heartbeat payloads /
+        :func:`~tensorflowonspark_tpu.telemetry.merge_counters`:
+        ``dispatch_count`` dispatches, ``dispatch_gap_us`` total host-side
+        time between dispatches (feed wait + checkpoint hook + bookkeeping;
+        device idle time when steps don't pipeline), ``dispatch_gap_us_hwm``
+        the worst single gap."""
+        return {
+            "dispatch_count": self._dispatch_count,
+            "dispatch_gap_us": self._dispatch_gap_us,
+            "dispatch_gap_us_hwm": self._dispatch_gap_us_hwm,
+        }
 
     def _get_multi_step(self, k):
         """Jitted program running ``k`` train steps in ONE dispatch via
@@ -442,7 +497,7 @@ class Trainer(object):
         return loss, aux
 
     def fit_feed(self, sharded_feed, max_steps=None, steps_per_call=1,
-                 on_steps=None):
+                 on_steps=None, transfer_guard=None):
         """Train from a :class:`~tensorflowonspark_tpu.parallel.infeed.ShardedFeed`
         until end-of-data consensus (or ``max_steps``); returns final stats.
 
@@ -460,7 +515,33 @@ class Trainer(object):
         dispatch (so once per K-step group) — the hook for periodic
         checkpointing: ``on_steps=lambda s: ckpt.maybe_save(s,
         trainer.state)`` (reading ``trainer.state`` there doesn't sync; the
-        manager pulls values only when the interval fires)."""
+        manager pulls values only when the interval fires, and with async
+        saves the serialization overlaps the following dispatches).
+
+        ``transfer_guard``: opt-in hot-loop invariant — wrap every dispatch
+        in ``jax.transfer_guard_host_to_device`` at this level
+        (``"disallow"``/``"log"``; ``None`` reads :data:`TRANSFER_GUARD_ENV`)
+        so a batch that is NOT already device-resident (an implicit
+        ``device_put`` sneaking back onto the dispatch path) is a hard error
+        instead of a silent MFU regression.  The guard wraps only the
+        dispatch calls, not the feed pulls: the infeed's own explicit
+        transfers (prefetch thread) stay legal either way.
+
+        The returned stats carry ``stats["overlap"]`` — this trainer's
+        dispatch-gap counters merged with the feed's ``infeed_*`` tallies
+        (see :meth:`counters_snapshot`)."""
+        from tensorflowonspark_tpu import telemetry
+
+        tracer = telemetry.get_tracer()
+        guard_level = _resolve_transfer_guard(transfer_guard)
+        # Ride heartbeats like the feeds do (duck-typed counters_snapshot;
+        # guarded for standalone use outside the node runtime).
+        try:
+            from tensorflowonspark_tpu import node as node_mod
+
+            node_mod._register_feed(self)
+        except Exception:  # pragma: no cover - stripped envs
+            pass
         last_loss = None
         # Host-side step counter: reading state.step would sync on the
         # just-dispatched device step and defeat the infeed's double
@@ -470,13 +551,25 @@ class Trainer(object):
             source = sharded_feed.grouped_batches(steps_per_call)
         else:
             source = (("single", b, m) for b, m in sharded_feed.batches())
+        prev_return = None
         for kind, batch, mask in source:
-            if kind == "multi":
-                loss = self.multi_step(batch, mask)
-                steps_done += int(jax.tree_util.tree_leaves(mask)[0].shape[0])
-            else:
-                loss, _ = self.step(batch, mask)
-                steps_done += 1
+            start = time.perf_counter()
+            if prev_return is not None:
+                gap_us = int((start - prev_return) * 1e6)
+                self._dispatch_gap_us += gap_us
+                if gap_us > self._dispatch_gap_us_hwm:
+                    self._dispatch_gap_us_hwm = gap_us
+            with tracer.span("train/dispatch", kind=kind), \
+                    _transfer_guard_ctx(guard_level):
+                if kind == "multi":
+                    loss = self.multi_step(batch, mask)
+                    steps_done += int(
+                        jax.tree_util.tree_leaves(mask)[0].shape[0])
+                else:
+                    loss, _ = self.step(batch, mask)
+                    steps_done += 1
+            prev_return = time.perf_counter()
+            self._dispatch_count += 1
             last_loss = loss
             if on_steps is not None:
                 on_steps(steps_done)
@@ -488,11 +581,20 @@ class Trainer(object):
                 if hasattr(sharded_feed, "terminate"):
                     sharded_feed.terminate()
                 break
+        overlap = dict(self.counters_snapshot())
+        if hasattr(sharded_feed, "counters_snapshot"):
+            try:
+                overlap.update(sharded_feed.counters_snapshot())
+            except Exception:  # pragma: no cover - duck-typed feeds
+                pass
         if self.history:
             self.history.on_train_end(last_loss)
-            return self.history.log_stats(
+            stats = self.history.log_stats(
                 loss=None if last_loss is None else float(last_loss))
-        return {}
+        else:
+            stats = {}
+        stats["overlap"] = overlap
+        return stats
 
     def restore_latest(self, ckpt_manager, validate=False):
         """Restore the newest checkpoint INTO this trainer's state (same
@@ -518,7 +620,8 @@ class Trainer(object):
 
 
 def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
-                   max_steps=None, steps_per_call=1, profiler=None):
+                   max_steps=None, steps_per_call=1, profiler=None,
+                   transfer_guard=None):
     """Supervised :meth:`Trainer.fit_feed`: restore-latest, train with
     periodic checkpoints, and on a retryable failure back off, re-restore,
     and try again from the last saved step.
@@ -535,7 +638,8 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
       retry_policy: a :class:`~tensorflowonspark_tpu.fault.RetryPolicy`
         (default policy when None).  Only retryable failures re-enter the
         loop; user-code bugs re-raise immediately.
-      max_steps / steps_per_call: forwarded to :meth:`Trainer.fit_feed`.
+      max_steps / steps_per_call / transfer_guard: forwarded to
+        :meth:`Trainer.fit_feed`.
       profiler: optional :class:`~tensorflowonspark_tpu.profiler.StepProfiler`;
         it is stepped once per dispatch and used as a context manager around
         every attempt, so an exception mid-capture stops the trace instead
@@ -574,7 +678,8 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
     def _fit_once():
         return trainer.fit_feed(feed_factory(), max_steps=max_steps,
                                 steps_per_call=steps_per_call,
-                                on_steps=_on_steps)
+                                on_steps=_on_steps,
+                                transfer_guard=transfer_guard)
 
     try:
         for attempt in range(policy.max_attempts):

@@ -83,6 +83,8 @@ def test_partial_evidence_drop(tmp_path):
     with open(partial / "transformer.json") as f:
         dropped = json.load(f)
     assert dropped["global_steps"] == 4
+    # provenance travels with the drop: this run measured it
+    assert dropped["value_source"] == "measured"
 
 
 def test_replayed_leg_fallback(tmp_path, monkeypatch):
@@ -188,3 +190,87 @@ def test_lm_tune_ladder_smoke(tmp_path):
     assert row["ms_per_step"] > 0
     assert row["config"]["seq"] == 64  # env knobs reached the child
     assert "mfu_pct" in row
+
+
+def _import_bench():
+    sys.path.insert(0, ROOT)
+    try:
+        import bench
+    finally:
+        sys.path.remove(ROOT)
+    return bench
+
+
+def test_probe_device_retries_with_exponential_backoff(monkeypatch):
+    """A flapping tunnel needs a growing pause: 3 attempts sleep 60 then
+    120 seconds between tries and surface the timeout verbatim."""
+    bench = _import_bench()
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", sleeps.append)
+
+    def timeout_run(*a, **kw):
+        raise bench.subprocess.TimeoutExpired(cmd="probe",
+                                              timeout=kw.get("timeout"))
+
+    monkeypatch.setattr(bench.subprocess, "run", timeout_run)
+    kind, err = bench.probe_device(timeout=1, attempts=3, retry_sleep=60)
+    assert kind is None and "timed out" in err
+    assert sleeps == [60, 120]
+
+
+def test_device_health_gates_per_leg_and_recovers(monkeypatch):
+    """One flap degrades ONE leg: a failed up-front probe gates the first
+    device leg, the quick re-probe before the next leg recovers, and a
+    timed-out leg re-arms the gate (tunnel-flap signature) while an
+    ordinary leg failure does not."""
+    bench = _import_bench()
+    probes = [(None, "device probe timed out after 1s (down)"),  # ctor
+              (None, "device probe timed out after 1s (still)"),  # leg 1
+              ("TPU v4", None)]                                   # leg 2
+
+    def fake_probe(*a, **kw):
+        return probes.pop(0) if probes else ("TPU v4", None)
+
+    monkeypatch.setattr(bench, "probe_device", fake_probe)
+    health = bench._DeviceHealth()
+    assert health.kind is None
+
+    ran = []
+
+    def fake_leg(leg, retries=1):
+        ran.append(leg)
+        return {"mfu": 0.1, "value_source": "measured"}, None
+
+    monkeypatch.setattr(bench, "run_leg_isolated", fake_leg)
+    stats, err = bench.run_device_leg("mnist", health)
+    assert stats is None and "timed out" in err and ran == []  # gated out
+    stats, err = bench.run_device_leg("resnet", health)
+    assert stats and err is None and ran == ["resnet"]  # re-probe recovered
+
+    # a timed-out leg marks the device suspect again...
+    monkeypatch.setattr(bench, "run_leg_isolated",
+                        lambda leg, retries=1: (None, "leg timed out"))
+    stats, err = bench.run_device_leg("transformer", health)
+    assert stats is None and health.err == "leg timed out"
+    # ...but an ordinary failure (bad config, OOM) does not re-arm the gate
+    health.err = None
+    monkeypatch.setattr(bench, "run_leg_isolated",
+                        lambda leg, retries=1: (None, "rc=1: ValueError"))
+    bench.run_device_leg("mnist", health)
+    assert health.err is None
+
+
+def test_replayed_leg_restamps_value_source(tmp_path, monkeypatch):
+    """Evidence drops carry value_source=measured from the run that made
+    them; a later run resurrecting one must re-stamp it replayed."""
+    bench = _import_bench()
+    partial = tmp_path / "legs"
+    partial.mkdir()
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(partial / "mnist.json", "w") as f:
+        json.dump({"mfu": 0.1, "value_source": "measured",
+                   "captured_utc": now}, f)
+    monkeypatch.setenv("TFOS_BENCH_PARTIAL_DIR", str(partial))
+    stats, captured = bench.load_partial_leg("mnist")
+    assert captured == now
+    assert stats["value_source"] == "replayed"

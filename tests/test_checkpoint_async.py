@@ -1,0 +1,209 @@
+"""Async checkpoint machinery (round 8): maybe_save must not block the step
+loop, the exit-path barriers must flush, worker errors must surface at the
+next sync point, and the snapshot must be donation-safe."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensorflowonspark_tpu import checkpoint as ckpt_mod
+
+
+def _slow_save(mgr, secs):
+    """Wrap the raw orbax save with an artificial write latency."""
+    orig = mgr._mgr.save
+
+    def slow(*a, **kw):
+        time.sleep(secs)
+        return orig(*a, **kw)
+
+    mgr._mgr.save = slow
+    return orig
+
+
+class TestAsyncSave:
+    def test_returns_before_write_lands(self, tmp_path):
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c"),
+                                         save_interval_steps=1,
+                                         async_save=True)
+        _slow_save(mgr, 0.5)
+        state = {"w": jnp.arange(4.0)}
+        t0 = time.perf_counter()
+        assert mgr.maybe_save(1, state)
+        took = time.perf_counter() - t0
+        assert took < 0.25, "maybe_save blocked {:.3f}s on the write".format(
+            took)
+        # raw orbax view (no drain): the write is still in flight
+        assert mgr._mgr.latest_step() is None
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 1
+        mgr.close()
+
+    def test_inflight_boundary_not_requeued(self, tmp_path):
+        """The save gates must see REQUESTED steps: while step 2's write is
+        in flight, orbax's latest_step still lags, and gating on it alone
+        would enqueue the same boundary twice."""
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c"),
+                                         save_interval_steps=2,
+                                         async_save=True)
+        _slow_save(mgr, 0.3)
+        state = {"w": jnp.ones(2)}
+        assert mgr.maybe_save(2, state)
+        assert not mgr.maybe_save(2, state)      # dup step, still in flight
+        assert not mgr.maybe_save(3, state)      # same interval boundary
+        assert not mgr.maybe_save(2, state, force=True)  # force dedups too
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 2
+        mgr.close()
+
+    def test_worker_error_surfaces_and_step_can_retry(self, tmp_path):
+        """A failed background write must raise at the next sync point, and
+        the request watermark must rewind so the SAME step can be re-saved
+        (otherwise one transient disk error permanently skips that step)."""
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c"),
+                                         save_interval_steps=1,
+                                         async_save=True)
+        orig = mgr._mgr.save
+
+        def boom(*a, **kw):
+            raise RuntimeError("disk full")
+
+        mgr._mgr.save = boom
+        state = {"w": jnp.ones(2)}
+        assert mgr.maybe_save(1, state)
+        with pytest.raises(RuntimeError, match="disk full"):
+            mgr.wait_until_finished()
+        mgr._mgr.save = orig
+        assert mgr.maybe_save(1, state)          # watermark rewound
+        mgr.wait_until_finished()
+        assert mgr.latest_step() == 1
+        mgr.close()
+
+    def test_snapshot_is_donation_safe(self, tmp_path):
+        """While the write is gated shut, delete the device buffer (what a
+        donating step does) and mutate the host leaf in place: the landed
+        checkpoint must hold the values from request time."""
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c"),
+                                         save_interval_steps=1,
+                                         async_save=True)
+        gate = threading.Event()
+        orig = mgr._mgr.save
+
+        def gated(*a, **kw):
+            assert gate.wait(30)
+            return orig(*a, **kw)
+
+        mgr._mgr.save = gated
+        w = jnp.arange(4.0)
+        host = np.arange(3.0)
+        assert mgr.maybe_save(1, {"w": w, "host": host})
+        host[:] = -1.0   # in-place host mutation after the request
+        w.delete()       # the step donated this buffer
+        gate.set()
+        mgr.wait_until_finished()
+        abstract = {"w": jnp.zeros(4), "host": np.zeros(3)}
+        restored, step = mgr.restore_latest(
+            jax.tree_util.tree_map(np.zeros_like, abstract))
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(4.0))
+        np.testing.assert_array_equal(np.asarray(restored["host"]),
+                                      np.arange(3.0))
+        mgr.close()
+
+    def test_latest_step_waits_for_inflight_save(self, tmp_path):
+        """latest_step() is a sync point: "latest" must include every save
+        maybe_save already accepted, or restart logic reads a stale step."""
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c"),
+                                         save_interval_steps=1,
+                                         async_save=True)
+        _slow_save(mgr, 0.3)
+        assert mgr.maybe_save(5, {"w": jnp.ones(2)}, force=True)
+        assert mgr.latest_step() == 5   # drained, not None/stale
+        mgr.close()
+
+    def test_async_landed_save_still_quarantinable(self, tmp_path):
+        """The crash-validation path is unchanged by async: a garbled newest
+        step (killed mid-flush) is quarantined and the previous retained
+        step restored."""
+        import os
+
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c"),
+                                         save_interval_steps=1,
+                                         async_save=True)
+        for step in (1, 2):
+            assert mgr.maybe_save(step, {"w": jnp.arange(4.0) * step})
+        mgr.wait_until_finished()
+        step_dir = os.path.join(mgr.directory, "2")
+        for root, _, files in os.walk(step_dir):
+            for fname in files:
+                with open(os.path.join(root, fname), "wb") as f:
+                    f.write(b"\xde\xad")
+        abstract = jax.tree_util.tree_map(np.zeros_like, {"w": jnp.zeros(4)})
+        restored, step = mgr.restore_latest_valid(abstract)
+        assert step == 1
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(4.0))
+        assert os.path.isdir(step_dir + ".corrupt")
+        mgr.close()
+
+    def test_env_kill_switch_forces_sync_saves(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ckpt_mod.ASYNC_CKPT_ENV, "0")
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c"),
+                                         save_interval_steps=1)
+        assert mgr.async_save is False
+        _slow_save(mgr, 0.2)
+        t0 = time.perf_counter()
+        assert mgr.maybe_save(1, {"w": jnp.ones(2)})
+        assert time.perf_counter() - t0 >= 0.2   # blocked: sync path
+        assert mgr.latest_step() == 1
+        mgr.close()
+
+
+def test_fit_supervised_flushes_final_save_before_return(tmp_path):
+    """The end-of-fit barrier: when fit_supervised returns, the final forced
+    save must have LANDED (raw orbax view), not merely been queued — callers
+    export/exit immediately after."""
+    from tensorflowonspark_tpu import manager
+    from tensorflowonspark_tpu.datafeed import DataFeed
+    from tensorflowonspark_tpu.parallel import build_mesh
+    from tensorflowonspark_tpu.parallel.infeed import ShardedFeed
+    from tensorflowonspark_tpu.train import Trainer, fit_supervised
+
+    m = manager.start(b"async-ckpt-test", ["input", "output", "error"])
+    try:
+        q = m.get_queue("input")
+        for i in range(32):
+            q.put([float(i % 5), float(i % 3)])
+        q.put(None)
+
+        def loss(params, batch, mask):
+            pred = batch @ params["w"]
+            return (pred ** 2 * mask).sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+        mesh = build_mesh()
+        trainer = Trainer(loss, {"w": jnp.zeros((2,))}, optax.sgd(0.01),
+                          mesh=mesh, batch_size=8)
+        mgr = ckpt_mod.CheckpointManager(str(tmp_path / "c"),
+                                         save_interval_steps=100,
+                                         async_save=True)
+        _slow_save(mgr, 0.2)
+        fit_supervised(
+            trainer, lambda: ShardedFeed(DataFeed(m), mesh,
+                                         global_batch_size=8, prefetch=2),
+            mgr)
+        # On-disk, finalized (no tmp suffix), no drain: the barrier ran.
+        import os
+
+        final = int(trainer.state.step)
+        assert final > 0
+        assert os.path.isdir(os.path.join(mgr.directory, str(final)))
+        mgr.close()
+    finally:
+        m.shutdown()

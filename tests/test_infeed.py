@@ -277,3 +277,136 @@ def test_trainer_evaluate_exact(mgr):
     assert out["mse"] == 0.0
     np.testing.assert_allclose(out["pred_sum"], np.mean(range(20)),
                                rtol=1e-6)
+
+
+# -- device-resident step loop (round 8) -------------------------------------
+
+
+def test_batches_device_resident_under_transfer_guard(mgr):
+    """Every leaf batches() yields is already a sharded jax.Array: consuming
+    them under an h2d transfer guard performs no implicit transfer (the
+    infeed's own explicit puts run before the guard scope)."""
+    import jax
+
+    _fill(mgr, [[float(i)] for i in range(16)])
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=2)
+    out = list(sf.batches())
+    assert len(out) == 2
+    consume = jax.jit(lambda b, m: (b[:, 0] * m).sum())
+    with jax.transfer_guard_host_to_device("disallow"):
+        for batch, mask in out:
+            assert isinstance(batch, jax.Array)
+            assert isinstance(mask, jax.Array)
+            float(consume(batch, mask))  # d2h read stays legal: h2d-only
+
+
+def test_fit_feed_transfer_guard_catches_host_batch():
+    """Regression pin for the MFU story: a feed handing HOST numpy arrays
+    to the dispatch loop is a hard error under the guard, not a silent
+    per-step device_put."""
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.train import Trainer
+
+    class HostFeed:
+        def batches(self):
+            for _ in range(2):
+                yield (np.zeros((8, 2), np.float32),
+                       np.ones((8,), np.float32))
+
+    def loss(params, batch, mask):
+        pred = batch @ params["w"]
+        return (pred ** 2 * mask).sum(), {}
+
+    tr = Trainer(loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                 mesh=build_mesh(), batch_size=8)
+    with pytest.raises(Exception, match="host-to-device"):
+        tr.fit_feed(HostFeed(), transfer_guard="disallow")
+
+
+def test_fit_feed_guard_env_clean_on_sharded_feed(mgr, monkeypatch):
+    """TFOS_TRANSFER_GUARD=disallow turns the guard on without code changes,
+    and the real ShardedFeed path passes it clean — including first-dispatch
+    compilation; the returned stats carry the overlap counters."""
+    from tensorflowonspark_tpu import train as train_mod
+
+    monkeypatch.setenv(train_mod.TRANSFER_GUARD_ENV, "disallow")
+    rows = [([float(i), 1.0], float(i)) for i in range(24)]
+    _fill(mgr, rows)
+    feed = DataFeed(mgr, input_mapping={"a_x": "x", "b_y": "y"})
+    mesh = build_mesh()
+    sf = ShardedFeed(feed, mesh, global_batch_size=8, prefetch=2)
+
+    import jax.numpy as jnp
+
+    def loss(params, batch, mask):
+        pred = jnp.asarray(batch["x"]) @ params["w"]
+        err = (pred - jnp.asarray(batch["y"])) ** 2 * mask
+        return err.sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+    tr = train_mod.Trainer(loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                           mesh=mesh, batch_size=8)
+    stats = tr.fit_feed(sf)
+    ov = stats["overlap"]
+    assert ov["dispatch_count"] == 3
+    assert ov["infeed_batches"] == 3
+    assert ov["infeed_put_us"] > 0
+    assert ov["infeed_assembly_us"] > 0
+    assert ov["dispatch_gap_us"] > 0  # 2 measured gaps (first has no prev)
+    assert ov["dispatch_gap_us_hwm"] <= ov["dispatch_gap_us"]
+
+
+def test_terminate_joins_prefetch_parked_in_feed_call():
+    """terminate() while the prefetch thread is parked inside the FEED's own
+    blocking call (not the queue get) must re-interrupt and join within the
+    bounded deadline — no leaked thread, no skipped drain."""
+    import threading
+    import time
+
+    class SlowFeed:
+        def __init__(self):
+            self.calls = 0
+            self.evt = threading.Event()
+            self.terminated = False
+
+        def should_stop(self):
+            return False
+
+        def next_batch_arrays(self, n):
+            self.calls += 1
+            if self.calls > 1:
+                self.evt.wait(30)   # parked until interrupt()
+                return np.zeros((0, 1), np.float32), 0
+            return np.ones((n, 1), np.float32), n
+
+        def interrupt(self):
+            self.evt.set()
+
+        def terminate(self):
+            self.terminated = True
+
+    feed = SlowFeed()
+    sf = ShardedFeed(feed, build_mesh(), global_batch_size=8, prefetch=2)
+    gen = sf.batches()
+    next(gen)                       # prefetch thread now parked in the feed
+    t0 = time.time()
+    sf.terminate()
+    assert time.time() - t0 < 10
+    t = sf._prefetch_thread
+    assert t is not None and not t.is_alive()
+    assert feed.terminated          # drain ran: the join succeeded
+    gen.close()
+
+
+def test_prefetch_depth_from_env(mgr, monkeypatch):
+    from tensorflowonspark_tpu.parallel import infeed as infeed_mod
+
+    monkeypatch.setenv(infeed_mod.PREFETCH_ENV, "5")
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8)
+    assert sf._prefetch_depth == 5
+    monkeypatch.delenv(infeed_mod.PREFETCH_ENV)
+    sf2 = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8)
+    assert sf2._prefetch_depth == infeed_mod.DEFAULT_PREFETCH
+    assert ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                       prefetch=0)._prefetch_depth == 0

@@ -527,3 +527,27 @@ def test_heartbeat_sender_stop_reason():
     assert server.bye_reasons() == {0: "preempted"}
     client.close()
     server.stop()
+
+
+def test_metrics_latch_is_keywise_not_wholesale():
+    """A later HBEAT/BYE payload that LOST a metrics source (the feed or
+    trainer was garbage collected with the user fn) must not erase the
+    counters earlier beats already reported — the latch folds key-wise,
+    newest value per key wins."""
+    server = reservation.Server(2, heartbeat_interval=0.2,
+                                heartbeat_misses=50)
+    addr = server.start()
+    client = reservation.Client(addr)
+    _register_worker(client)
+    assert client.heartbeat(0, metrics={"feed_items": 10,
+                                        "infeed_batches": 4})
+    assert client.heartbeat(0, metrics={"feed_items": 25})  # source GC'd
+    node = server.metrics_snapshot()["nodes"]["0"]
+    assert node == {"feed_items": 25, "infeed_batches": 4}
+    # the final BYE snapshot folds the same way
+    client.goodbye(0, reason="done", metrics={"feed_items": 30})
+    snap = server.metrics_snapshot()
+    assert snap["nodes"]["0"] == {"feed_items": 30, "infeed_batches": 4}
+    assert snap["aggregate"]["infeed_batches"] == 4
+    client.close()
+    server.stop()

@@ -250,3 +250,10 @@ def test_configure_from_meta_env_fallback(tmp_path, monkeypatch):
     monkeypatch.setenv(telemetry.TELEMETRY_DIR_ENV, str(tmp_path))
     tracer = telemetry.configure_from_meta({})
     assert tracer.enabled and tracer.out_dir == str(tmp_path)
+
+
+def test_null_tracer_counter_max_is_noop():
+    """Regression: the heartbeat/infeed paths call counter_max on whatever
+    get_tracer() returns — the NULL tracer must absorb it, not raise."""
+    telemetry.NULL.counter_max("depth_hwm", 5)
+    telemetry.NULL.counter_add("n", 2)

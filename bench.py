@@ -215,6 +215,17 @@ def _run_synthetic_leg(trainer, batch, mask, k, steps, stats_path, chief,
     stats = trainer.history.build_stats(loss=float(loss))
     stats["n_devices"] = len(jax.devices())
     stats["device_kind"] = jax.devices()[0].device_kind
+    # Fold the runtime accountant over the closed TimeHistory windows and
+    # publish its view (latest-window MFU gauge + step-time histogram)
+    # alongside build_stats' whole-run mfu: every bench artifact then
+    # carries the runtime-MFU-vs-bench-MFU cross-check the observatory's
+    # CI gate asserts (<=5% apart), instead of that agreement only being
+    # checkable on a live /metrics scrape.
+    trainer._account_windows()
+    acct = {k: v for k, v in trainer.counters_snapshot().items()
+            if k.startswith(("train_", "step_ms"))}
+    if acct:
+        stats["runtime_accountant"] = acct
     if extra:
         stats.update(extra)
     if chief:

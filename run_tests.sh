@@ -30,13 +30,17 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # prove the elastic-recovery loop closes on a real 3-node cluster, prove
 # the telemetry plane produces parseable traces + HBEAT counters, prove
 # the data service keeps its exactly-once guarantee through a worker
-# SIGKILL (dispatcher + 2 worker subprocesses + 2 consumers), then prove
-# the step loop overlaps: guard-clean device-resident dispatches, async
-# checkpoint saves, and dispatch-gap counters reaching the driver
+# SIGKILL (dispatcher + 2 worker subprocesses + 2 consumers), prove the
+# step loop overlaps: guard-clean device-resident dispatches, async
+# checkpoint saves, and dispatch-gap counters reaching the driver, then
+# prove the observatory answers live: /metrics + /status scrapeable
+# mid-run with the MFU/goodput accountant, counters monotone, and trace
+# flow events linking a data-service split to a consumer-side dispatch
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
 python scripts/ci_assert_dataservice.py
 python scripts/ci_assert_overlap.py
+python scripts/ci_assert_observatory.py
 
 exit $rc

@@ -273,13 +273,144 @@ def validate_done():
     return _load_json("device_validate.json") is not None
 
 
+# ── regression diff mode (--diff): fresh round vs previous round ──
+
+# headline metric -> (bench leg it came from, direction).  Direction decides
+# what counts as a regression: "higher" metrics regress when they drop,
+# "lower" metrics regress when they grow.
+HEADLINE_METRICS = (
+    ("resnet50_train_mfu", "resnet", "higher"),
+    ("resnet50_mfu", "resnet", "higher"),
+    ("resnet50_step_time_ms", "resnet", "lower"),
+    ("resnet50_images_per_sec_per_chip", "resnet", "higher"),
+    ("mnist_e2e_images_per_sec_per_chip", "mnist", "higher"),
+    ("mnist_ms_per_step", "mnist", "lower"),
+    ("transformer_lm_train_mfu", "transformer", "higher"),
+    ("transformer_lm_step_time_ms", "transformer", "lower"),
+    ("feed_plane_images_per_sec", "feed_plane", "higher"),
+)
+
+
+def _parsed(doc):
+    """Headline dict from either shape we persist: a BENCH_r*.json wrapper
+    ({"n", "cmd", "rc", "tail", "parsed"}) or a bare bench.py output line
+    (.bench_watch/bench.json)."""
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    return doc if isinstance(doc, dict) else {}
+
+
+def _replayed_legs(parsed):
+    """Legs whose numbers were replayed from earlier evidence rather than
+    measured this round.  Two markers exist across rounds: ``replayed_legs``
+    (list or leg->timestamp dict, r05+) and ``value_source``/``leg_sources``
+    (per-leg source strings).  A leg is replayed if any marker says so."""
+    legs = set((parsed or {}).get("replayed_legs") or ())
+    for key in ("value_source", "leg_sources"):
+        src = (parsed or {}).get(key)
+        if isinstance(src, str) and "replay" in src:
+            # whole-round marker: taint every leg
+            legs.update(leg for _, leg, _ in HEADLINE_METRICS)
+        elif isinstance(src, dict):
+            legs.update(k for k, v in src.items()
+                        if isinstance(v, str) and "replay" in v)
+    return legs
+
+
+def _bench_rounds():
+    """BENCH_r*.json paths in round order (oldest first)."""
+    import glob
+    import re
+    rounds = []
+    for path in glob.glob(os.path.join(ROOT, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            rounds.append((int(m.group(1)), path))
+    return [p for _, p in sorted(rounds)]
+
+
+def run_diff(paths, threshold):
+    """Compare a fresh round's headline metrics against the previous round.
+
+    ``paths``: [] -> the two newest BENCH_r*.json; [fresh] -> fresh vs the
+    newest BENCH_r*.json; [fresh, baseline] -> exactly those.  Replayed legs
+    (on either side) are reported but can NEVER alarm: a replayed number is
+    the same measurement as its source round, so any "regression" in it is
+    a fact about the replay plumbing, not the code under test.  Exits 1 when
+    any measured headline regresses by more than ``threshold`` percent.
+    """
+    if len(paths) < 2:
+        rounds = _bench_rounds()
+        need = 2 - len(paths)
+        if len(rounds) < need:
+            print("bench_watch --diff: need %d BENCH_r*.json under %s, "
+                  "found %d" % (need, ROOT, len(rounds)), file=sys.stderr)
+            return 2
+        # paths given are the FRESH side; baselines come from the archive
+        paths = list(paths) + rounds[-need:][::-1]
+    fresh_path, base_path = paths[0], paths[1]
+    try:
+        with open(fresh_path) as f:
+            fresh = _parsed(json.load(f))
+        with open(base_path) as f:
+            base = _parsed(json.load(f))
+    except (OSError, ValueError) as e:
+        print("bench_watch --diff: %s" % e, file=sys.stderr)
+        return 2
+    tainted = _replayed_legs(fresh) | _replayed_legs(base)
+
+    print("bench diff: %s (fresh) vs %s (baseline), threshold %.1f%%"
+          % (os.path.basename(fresh_path), os.path.basename(base_path),
+             threshold))
+    fmt = "%-34s %12s %12s %9s  %s"
+    print(fmt % ("metric", "baseline", "fresh", "delta", "verdict"))
+    regressions = []
+    for metric, leg, direction in HEADLINE_METRICS:
+        old, new = base.get(metric), fresh.get(metric)
+        if not isinstance(old, (int, float)) or not isinstance(
+                new, (int, float)) or old == 0:
+            continue   # absent in one round (legs grow over time): no row
+        pct = 100.0 * (new - old) / old
+        # signed so that positive always means "got worse"
+        worse = pct if direction == "lower" else -pct
+        if leg in tainted:
+            verdict = "replayed (never alarms)"
+        elif worse > threshold:
+            verdict = "REGRESSED"
+            regressions.append((metric, worse))
+        elif worse < -threshold:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        print(fmt % (metric, "%g" % old, "%g" % new,
+                     "%+.1f%%" % pct, verdict))
+    if regressions:
+        print("\n%d headline regression(s) past %.1f%%:" %
+              (len(regressions), threshold))
+        for metric, worse in regressions:
+            print("  %s: %.1f%% worse" % (metric, worse))
+        return 1
+    print("\nno measured headline regressions past %.1f%%" % threshold)
+    return 0
+
+
 def main():
     global _LOG_FH
     ap = argparse.ArgumentParser()
     ap.add_argument("--hours", type=float, default=11.0)
     ap.add_argument("--interval", type=float, default=45.0,
                     help="seconds between probes while the tunnel is down")
+    ap.add_argument("--diff", nargs="*", metavar="JSON", default=None,
+                    help="diff mode: compare headline metrics between two "
+                         "rounds instead of watching.  With no paths, the "
+                         "two newest BENCH_r*.json; with one, that file vs "
+                         "the newest archived round; with two, fresh then "
+                         "baseline.  Exits 1 past --diff-threshold.")
+    ap.add_argument("--diff-threshold", type=float, default=10.0,
+                    help="regression alarm threshold, percent (default 10)")
     args = ap.parse_args()
+    if args.diff is not None:
+        return run_diff(args.diff, args.diff_threshold)
     os.makedirs(OUT_DIR, exist_ok=True)
     _LOG_FH = open(os.path.join(OUT_DIR, "watch.log"), "a")
 

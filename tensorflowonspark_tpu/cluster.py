@@ -43,7 +43,7 @@ class TPUCluster(object):
     ``TFCluster.py:29-207``)."""
 
     def __init__(self, backend, cluster_meta, cluster_info, input_mode,
-                 server, start_job, tf_status, queues):
+                 server, start_job, tf_status, queues, observatory=None):
         self.backend = backend
         self.cluster_meta = cluster_meta
         self.cluster_info = cluster_info
@@ -52,6 +52,10 @@ class TPUCluster(object):
         self.start_job = start_job
         self.tf_status = tf_status
         self.queues = queues
+        # optional observatory.ObservatoryServer (cluster.run(observatory=
+        # True)): live /metrics + /status HTTP endpoint; stopped with the
+        # cluster on every shutdown path (see _latch_telemetry)
+        self.observatory = observatory
 
     # -- data plane -------------------------------------------------------
 
@@ -263,6 +267,14 @@ class TPUCluster(object):
                 self.tf_status.setdefault("telemetry", snap)
         except Exception:
             logger.debug("telemetry latch failed", exc_info=True)
+        if self.observatory is not None:
+            # exporter outlives the nodes (scrapes tolerate node death) but
+            # not the cluster handle; stop is idempotent across the several
+            # shutdown paths that reach this latch
+            try:
+                self.observatory.stop()
+            except Exception:
+                logger.debug("observatory stop failed", exc_info=True)
         telemetry_mod.get_tracer().flush()
 
     def inference(self, data, qname="input", chunk_size=1024):
@@ -494,7 +506,8 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         queues=("input", "output", "error"), eval_node=False,
         release_port=True, profiler=False, executor_env=None,
         driver_ps_nodes=False, heartbeat_interval=5.0, heartbeat_misses=3,
-        telemetry=False, telemetry_dir=None, data_service=None):
+        telemetry=False, telemetry_dir=None, data_service=None,
+        observatory=False, observatory_port=0):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -539,6 +552,16 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         dict) — executors then read input over the network via
         ``ctx.get_service_feed(...)`` instead of reading files locally.
         See docs/DATA_SERVICE.md.
+      observatory: start the driver-side HTTP observatory — ``/metrics``
+        (Prometheus text exposition) and ``/status`` (JSON ``tf_status`` +
+        metrics snapshot), scrapeable mid-run; per-node counter samples are
+        kept in a bounded time-series ring so the exporter also derives
+        ``*_per_sec`` rates.  The endpoint address lands on the returned
+        cluster handle (``cluster.observatory.addr``).  Implies nothing
+        about ``telemetry`` — but with telemetry off, nodes send bare
+        beats and the exporter mostly shows ``tfos_nodes``; enable both
+        for the full metric vocabulary.  See docs/OBSERVABILITY.md.
+      observatory_port: TCP port for the observatory (0 = ephemeral).
     """
     if hasattr(cluster_backend, "parallelize"):  # raw SparkContext
         cluster_backend = backend_mod.SparkBackend(cluster_backend)
@@ -670,6 +693,24 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                                 on_dead=_on_dead, on_bye=_on_bye)
     server_addr = server.start()
 
+    obs = None
+    if observatory:
+        from tensorflowonspark_tpu import observatory as observatory_mod
+
+        # Sample ring first: the server records a timestamped copy of each
+        # node's folded counters on every metrics-bearing beat, so the
+        # exporter can derive rates; the HTTP endpoint reads only through
+        # snapshot callables (copies), so scrapes are safe mid-run and
+        # mid-node-death.
+        ring = observatory_mod.SampleRing()
+        server.sample_ring = ring
+        obs = observatory_mod.ObservatoryServer(
+            server.metrics_snapshot, ring=ring,
+            status_fn=lambda: tf_status, port=observatory_port)
+        addr = obs.start()
+        logger.info("observatory serving /metrics and /status at "
+                    "http://%s:%d", addr[0], addr[1])
+
     # Normalize the data-service spec to {"dispatcher": [host, port]} for
     # the JSON hop to executors (ctx.get_service_feed consumes it).
     if data_service is not None:
@@ -770,4 +811,5 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         seen.add(key)
 
     return TPUCluster(cluster_backend, cluster_meta, cluster_info, input_mode,
-                      server, start_job, tf_status, tuple(queues))
+                      server, start_job, tf_status, tuple(queues),
+                      observatory=obs)

@@ -55,6 +55,7 @@ Wire protocol: the dispatcher speaks the length-prefixed-JSON
 colv1 frame, kind 2 pickled rows.
 """
 
+import collections
 import json
 import logging
 import pickle
@@ -530,6 +531,20 @@ class DispatcherServer(MessageSocket):
                     ans = job.next_splits(worker_id, data.get("consumer_id"),
                                           list(self._workers))
                     ans["type"] = "TASK"
+                    if ans.get("splits"):
+                        # Trace flow: a fresh id rides the assignment to the
+                        # worker, the stream frames, and the consumer commit,
+                        # so Perfetto links assignment -> serve -> commit ->
+                        # infeed -> dispatch causally across processes.
+                        tracer = telemetry.get_tracer()
+                        fid = tracer.new_flow_id()
+                        if fid:
+                            ans["flow"] = fid
+                            tracer.flow_start(
+                                "dataservice/split_flow", fid,
+                                job=job.name, worker_id=worker_id,
+                                splits=list(ans["splits"]),
+                                epoch=ans.get("epoch"))
                     self.send(sock, ans)
             elif mtype == "LOST":
                 job = self._jobs.get(data.get("job"))
@@ -882,7 +897,7 @@ class FeedWorker(object):
                     for split, path in task["splits"]:
                         self._stream_split(conn, client, job, consumer,
                                            split, int(task.get("epoch", 0)),
-                                           path)
+                                           path, flow=task.get("flow"))
         except (EOFError, OSError) as e:
             logger.info("feed worker %s: stream closed (%s)",
                         self.worker_id, e)
@@ -914,15 +929,24 @@ class FeedWorker(object):
         return data.FileFeed([path], row_reader=self.row_reader,
                              reader_threads=1, shard=False)
 
-    def _stream_split(self, conn, client, job, consumer, split, epoch, path):
+    def _stream_split(self, conn, client, job, consumer, split, epoch, path,
+                      flow=None):
         # Reader faults (unreadable file, bad records) are kept separate
         # from socket faults: the reader calls sit in their own try so an
         # OSError from the filesystem is never mistaken for a dead stream.
         tracer = telemetry.get_tracer()
+        if flow:
+            # flow ids ride the stream's control frames so the consumer can
+            # continue the dispatcher-started trace flow across processes
+            tracer.flow_step("dataservice/split_flow", flow,
+                             leg="worker_serve", split=split,
+                             worker_id=self.worker_id)
         with tracer.span("dataservice/split_stream", split=split,
                          epoch=epoch, worker_id=self.worker_id):
-            _send_json(conn, {"type": "split_begin", "split": split,
-                              "epoch": epoch})
+            begin = {"type": "split_begin", "split": split, "epoch": epoch}
+            if flow:
+                begin["flow"] = flow
+            _send_json(conn, begin)
             feed = None
             try:
                 try:
@@ -945,8 +969,10 @@ class FeedWorker(object):
             finally:
                 if feed is not None:
                     feed.terminate()
-            _send_json(conn, {"type": "split_end", "split": split,
-                              "epoch": epoch})
+            end = {"type": "split_end", "split": split, "epoch": epoch}
+            if flow:
+                end["flow"] = flow
+            _send_json(conn, end)
         self.splits_streamed += 1
         self._injector.on_split()
 
@@ -1071,6 +1097,11 @@ class ServiceFeed(object):
         self._committed = set()     # (epoch, split) commit dedupe
         self._done_pending = set()  # committed keys whose DONE hasn't landed
         self._commit_lock = threading.Lock()
+        # Trace-flow ids of recently committed splits, drained by the
+        # downstream infeed/trainer (``pop_flow_id``) so the dispatcher-
+        # started flow reaches the dispatch leg.  Bounded: an unobserved
+        # flow just drops off (flows are best-effort diagnostics).
+        self._flow_pending = collections.deque(maxlen=16)
         self._started = False
         self._streams = {}          # worker_id -> receiver thread
         self._stream_socks = {}     # worker_id -> socket
@@ -1291,7 +1322,8 @@ class ServiceFeed(object):
                         pending = []
                     elif mtype == "split_end":
                         self._commit_split(
-                            (int(msg["epoch"]), int(msg["split"])), pending)
+                            (int(msg["epoch"]), int(msg["split"])), pending,
+                            flow=msg.get("flow"))
                         cur, pending = None, []
                     elif mtype == "split_abort":
                         # worker-side reader fault: the stream is healthy
@@ -1369,7 +1401,7 @@ class ServiceFeed(object):
         self.bytes_received += len(payload)
         return chunk
 
-    def _commit_split(self, key, chunks):
+    def _commit_split(self, key, chunks, flow=None):
         """Exactly-once commit: publish once, report ``DONE`` at-least-once.
 
         The publish happens exactly once per ``(epoch, split)`` (the
@@ -1394,6 +1426,13 @@ class ServiceFeed(object):
         telemetry.get_tracer().instant(
             "dataservice/split_commit", split=key[1], epoch=key[0],
             consumer=self.consumer_id)
+        if flow:
+            # continue the dispatcher-started flow in this process and park
+            # the id for the infeed/trainer to pick up (pop_flow_id)
+            telemetry.get_tracer().flow_step(
+                "dataservice/split_flow", flow, leg="split_commit",
+                split=key[1], epoch=key[0], consumer=self.consumer_id)
+            self._flow_pending.append(int(flow))
         try:
             client = self.retry_policy.call(
                 lambda: DispatcherClient(self.dispatcher_addr))
@@ -1582,6 +1621,18 @@ class ServiceFeed(object):
                 break
         self._buffer, self._buffer_idx = [], 0
         self._done = True
+
+    def pop_flow_id(self):
+        """Oldest undrained trace-flow id of a committed split (or None).
+
+        Drained by the downstream :class:`~...parallel.infeed.ShardedFeed` /
+        :class:`~...train.Trainer` so the dispatcher-started flow event
+        chain continues through device infeed and dispatch.  Best-effort:
+        ids of splits nobody drained age out of the bounded deque."""
+        try:
+            return self._flow_pending.popleft()
+        except IndexError:
+            return None
 
     def counters_snapshot(self):
         """Flat telemetry counters for heartbeat payloads (the

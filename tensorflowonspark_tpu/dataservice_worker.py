@@ -43,7 +43,15 @@ def main(argv=None):
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
-    from tensorflowonspark_tpu import data, dataservice
+    from tensorflowonspark_tpu import data, dataservice, telemetry
+
+    # Standalone workers opt into telemetry via TFOS_TELEMETRY /
+    # TFOS_TELEMETRY_DIR (no cluster_meta hop reaches a CLI process), and
+    # get the SIGUSR1 flight recorder either way the cluster shells do:
+    # a hung worker can then be asked for stacks (`kill -USR1 <pid>`)
+    # instead of diagnosed post-mortem.
+    tracer = telemetry.configure_from_meta({})
+    telemetry.install_sigusr1()
 
     row_reader = (data.jsonl_rows if args.reader == "jsonl"
                   else data.tfrecord_rows)
@@ -63,6 +71,7 @@ def main(argv=None):
     except KeyboardInterrupt:
         pass
     worker.stop()
+    tracer.flush()
     return 0
 
 

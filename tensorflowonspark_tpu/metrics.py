@@ -30,6 +30,35 @@ PEAK_FLOPS = {
 }
 
 
+# Step-time histogram bucket upper bounds, in milliseconds.  Shared by the
+# Trainer's runtime accountant (``step_ms_le_<bound>`` heartbeat counters)
+# and the observatory's Prometheus rendering (``tfos_step_ms_bucket{le=}``),
+# so the two never disagree on bucket edges.  Roughly log-spaced from a
+# sub-millisecond CPU toy step to a multi-second pathological stall.
+STEP_MS_BUCKETS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000)
+
+
+def achieved_flops_per_sec(step_flops, step_seconds):
+    """Achieved per-device FLOP/s for one dispatch (None when unknowable)."""
+    if not step_flops or not step_seconds or step_seconds <= 0:
+        return None
+    return step_flops / step_seconds
+
+
+def mfu_from_step_time(step_flops, step_seconds):
+    """MFU for one step from per-device FLOPs and wall seconds.
+
+    The exact formula :meth:`TimeHistory.mfu` applies (per-device FLOPs over
+    per-device peak over step seconds) — exposed standalone so the runtime
+    accountant (``train.Trainer``) and the bench scripts compute the same
+    number from the same inputs.
+    """
+    peak = peak_flops_per_device()
+    if peak is None or not step_flops or not step_seconds or step_seconds <= 0:
+        return None
+    return step_flops / peak / step_seconds
+
+
 def peak_flops_per_device():
     import jax
 
@@ -198,11 +227,9 @@ class TimeHistory(object):
 
     def mfu(self, step_seconds):
         # step_flops and peak are both per-device figures (XLA cost analysis
-        # reports the partitioned per-device module), so no num_devices term.
-        peak = peak_flops_per_device()
-        if peak is None or not self.step_flops or step_seconds <= 0:
-            return None
-        return self.step_flops / peak / step_seconds
+        # reports the partitioned per-device module), so no num_devices term;
+        # delegated so the runtime accountant provably shares the formula.
+        return mfu_from_step_time(self.step_flops, step_seconds)
 
     # -- summary (reference build_stats, common.py:202-245) ---------------
 

@@ -90,6 +90,13 @@ def _node_metrics_provider(mgr, qname="input"):
         if not telemetry.get_tracer().enabled:
             return None
         parts = [shmring.counters_snapshot()]
+        try:
+            # tracer self-telemetry: a nonzero events_dropped means this
+            # process's trace files are silently truncated — surfaced as a
+            # heartbeat counter so the driver sees it live, not post-mortem
+            parts.append(telemetry.get_tracer().counters_snapshot())
+        except Exception:
+            pass
         for ref in list(_feeds):
             feed = ref()
             if feed is None:
@@ -542,6 +549,16 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             "profiler_port": profiler_port,
             "working_dir": os.getcwd(),
         }
+        # Trace flow across the rendezvous: started here, stepped by the
+        # driver on REG admission, ended on this node's first heartbeat —
+        # Perfetto then links registration -> admission -> liveness causally
+        # across the node/driver process boundary.
+        reg_flow = tracer.new_flow_id()
+        if reg_flow:
+            node_meta["trace_flow"] = reg_flow
+            tracer.flow_start("reservation/register_flow", reg_flow,
+                              leg="node_register", executor_id=executor_id,
+                              job_name=job_name)
         with tracer.span("node/register", executor_id=executor_id,
                          job_name=job_name, task_index=task_index):
             client.register(node_meta)
@@ -606,7 +623,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             hb = reservation.HeartbeatSender(
                 cluster_meta["server_addr"], executor_id,
                 heartbeat_interval,
-                metrics_provider=_node_metrics_provider(context.mgr)).start()
+                metrics_provider=_node_metrics_provider(context.mgr),
+                trace_flow=node_meta.get("trace_flow")).start()
             # Forked children inherit the parent's preemption registrations;
             # start from a clean slate, then install the SIGTERM drain in the
             # process that actually runs the user fn.
@@ -686,7 +704,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             hb = reservation.HeartbeatSender(
                 cluster_meta["server_addr"], executor_id,
                 heartbeat_interval,
-                metrics_provider=_node_metrics_provider(mgr)).start()
+                metrics_provider=_node_metrics_provider(mgr),
+                trace_flow=node_meta.get("trace_flow")).start()
             _reset_preemption()
             _install_sigterm_drain()
             telemetry.install_sigusr1()

@@ -1,0 +1,402 @@
+"""Live driver-side observatory: time-series samples + HTTP exporter.
+
+The telemetry plane (PR 4-6) latches *latest* per-node counter snapshots
+into the reservation server at heartbeat cadence and aggregates them on
+demand — enough for a post-mortem, useless for watching a run approach the
+MFU bar: a single latest value has no rate, and nothing serves it while
+the job is alive.  This module closes both gaps without adding a single
+dependency:
+
+- :class:`SampleRing` — a bounded ring of ``(wall_ts, counters)`` samples
+  per node, fed by the reservation server every time a heartbeat (or BYE)
+  carries metrics.  Rates become derivable: ``items/s`` is the first/last
+  delta over the window, dispatch-gap and queue-depth trends fall out the
+  same way.
+- :func:`render_prometheus` — the driver's current snapshot + ring in
+  Prometheus text exposition format (version 0.0.4): ``HELP``/``TYPE``
+  lines, sanitized metric names, per-executor labels, correct counter vs
+  gauge typing (the telemetry ``_hwm``/``_max`` suffix convention maps to
+  gauges, everything else to counters), and the Trainer's
+  ``step_ms_le_<bound>`` counters folded into one proper histogram.
+- :class:`ObservatoryServer` — a stdlib ``ThreadingHTTPServer`` serving
+  ``GET /metrics`` (Prometheus text) and ``GET /status`` (JSON:
+  ``tf_status`` + ``metrics_snapshot`` + ring depths), started by
+  ``cluster.run(..., observatory=True)`` next to the rendezvous and
+  stopped with it.  Every render works from ONE snapshot copy taken at
+  scrape start, so a node dying mid-scrape can never produce a
+  half-mutated exposition.
+
+Metric vocabulary: every counter key that rides heartbeats appears as
+``tfos_<key>_total`` (counter) or ``tfos_<key>`` (gauge, for ``_hwm`` /
+``_max`` keys), labeled ``{executor="<id>"}``, plus the
+cluster-level ``tfos_nodes``, ``tfos_scrapes_total``, and the windowed
+``tfos_rate{key=...}`` gauges derived from the ring.
+"""
+
+import json
+import logging
+import re
+import threading
+import time
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.metrics import STEP_MS_BUCKETS
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["SampleRing", "render_prometheus", "ObservatoryServer",
+           "DEFAULT_RING_CAPACITY"]
+
+#: samples kept per node (at 1 s heartbeats: ~8.5 min of history)
+DEFAULT_RING_CAPACITY = 512
+
+# Prometheus metric-name charset ([a-zA-Z_:][a-zA-Z0-9_:]*); every rejected
+# character collapses to "_".
+_NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+# Keys with gauge semantics: high-water marks and latest-value readings
+# (the merge_counters max-suffix convention, plus the runtime accountant's
+# percentage/rate gauges which also use the _max suffix).
+_GAUGE_SUFFIXES = ("_hwm", "_max")
+
+# The Trainer's bucketed step-time histogram rides heartbeats as flat
+# cumulative counters; the renderer reassembles them into one Prometheus
+# histogram per executor.
+_HIST_PREFIX = "step_ms_le_"
+_HIST_COUNT = "step_ms_count"
+_HIST_SUM_US = "step_ms_sum_us"
+
+
+def _metric_name(key):
+    """``tfos_``-prefixed, charset-sanitized Prometheus metric name."""
+    name = "tfos_" + _NAME_BAD.sub("_", str(key))
+    if not _NAME_OK.match(name):  # first char still illegal after prefix
+        name = "tfos_x" + _NAME_BAD.sub("_", str(key))
+    return name
+
+
+def _escape_label(value):
+    return (str(value).replace("\\", "\\\\").replace("\"", "\\\"")
+            .replace("\n", "\\n"))
+
+
+def _fmt_value(value):
+    if isinstance(value, float):
+        return repr(value)
+    return str(value)
+
+
+class SampleRing(object):
+    """Bounded per-node ring of timestamped counter samples.
+
+    ``record`` is called from the reservation listener thread (one writer);
+    ``series`` / ``rates`` may be called from any scraper thread.  All state
+    lives behind one lock; readers get copies.
+    """
+
+    def __init__(self, capacity=DEFAULT_RING_CAPACITY):
+        self.capacity = max(int(capacity), 2)
+        self._lock = threading.Lock()
+        self._rings = {}  # node id -> list of (ts, counters) newest-last
+
+    def record(self, node_id, counters, ts=None):
+        if not isinstance(counters, dict):
+            return
+        ts = time.time() if ts is None else ts
+        with self._lock:
+            ring = self._rings.setdefault(str(node_id), [])
+            ring.append((ts, dict(counters)))
+            if len(ring) > self.capacity:
+                del ring[:len(ring) - self.capacity]
+
+    def series(self):
+        """``{node_id: [(ts, counters), ...]}`` — copies, newest last."""
+        with self._lock:
+            return {n: list(ring) for n, ring in self._rings.items()}
+
+    def depths(self):
+        with self._lock:
+            return {n: len(ring) for n, ring in self._rings.items()}
+
+    def rates(self, window_secs=60.0):
+        """Per-node per-key rates over the trailing window.
+
+        For each summing counter key (gauge-suffix keys are skipped), the
+        delta between the newest sample and the oldest sample inside the
+        window, over their timestamp span.  Nodes with fewer than two
+        in-window samples contribute nothing.  Negative deltas (a restarted
+        node whose counters reset) are clamped to zero rather than reported
+        as a negative rate.
+        """
+        out = {}
+        now = time.time()
+        for node_id, ring in self.series().items():
+            in_window = [(ts, c) for ts, c in ring
+                         if now - ts <= window_secs]
+            if len(in_window) < 2:
+                continue
+            (t0, c0), (t1, c1) = in_window[0], in_window[-1]
+            span = t1 - t0
+            if span <= 0:
+                continue
+            node_rates = {}
+            for key, v1 in c1.items():
+                if key.endswith(_GAUGE_SUFFIXES):
+                    continue
+                if isinstance(v1, bool) or not isinstance(v1, (int, float)):
+                    continue
+                v0 = c0.get(key, 0)
+                if isinstance(v0, bool) or not isinstance(v0, (int, float)):
+                    v0 = 0
+                node_rates[key] = max(v1 - v0, 0) / span
+            if node_rates:
+                out[node_id] = node_rates
+        return out
+
+
+class _Families(object):
+    """Accumulates samples grouped by metric family.
+
+    The text format requires every sample of a family to sit in one
+    contiguous block under its HELP/TYPE preamble — so samples are
+    collected per family first and concatenated at the end, never
+    interleaved per executor.
+    """
+
+    def __init__(self):
+        self._order = []
+        self._fam = {}  # name -> (mtype, help, [sample lines])
+
+    def add(self, name, mtype, help_text, sample_line):
+        fam = self._fam.get(name)
+        if fam is None:
+            fam = (mtype, help_text, [])
+            self._fam[name] = fam
+            self._order.append(name)
+        fam[2].append(sample_line)
+
+    def render(self):
+        lines = []
+        for name in self._order:
+            mtype, help_text, samples = self._fam[name]
+            lines.append("# HELP %s %s" % (name, help_text))
+            lines.append("# TYPE %s %s" % (name, mtype))
+            lines.extend(samples)
+        return "\n".join(lines) + "\n"
+
+
+def _render_histogram(fams, executor, counters):
+    """Reassemble ``step_ms_le_*`` flat counters into a histogram family."""
+    buckets = {}
+    for key, val in counters.items():
+        if key.startswith(_HIST_PREFIX):
+            try:
+                bound = float(key[len(_HIST_PREFIX):].replace("_", "."))
+            except ValueError:
+                continue
+            buckets[bound] = val
+    count = counters.get(_HIST_COUNT)
+    if not buckets and not count:
+        return
+    name = "tfos_step_ms"
+    help_text = "Step wall time per dispatch, milliseconds."
+    label = _escape_label(executor)
+    cumulative = 0
+    for bound in sorted(buckets):
+        cumulative = buckets[bound]
+        fams.add(name, "histogram", help_text,
+                 '%s_bucket{executor="%s",le="%s"} %s'
+                 % (name, label, _fmt_value(float(bound)),
+                    _fmt_value(buckets[bound])))
+    inf_count = count if count is not None else cumulative
+    fams.add(name, "histogram", help_text,
+             '%s_bucket{executor="%s",le="+Inf"} %s'
+             % (name, label, _fmt_value(inf_count)))
+    fams.add(name, "histogram", help_text,
+             '%s_count{executor="%s"} %s' % (name, label,
+                                             _fmt_value(inf_count)))
+    sum_us = counters.get(_HIST_SUM_US, 0)
+    fams.add(name, "histogram", help_text,
+             '%s_sum{executor="%s"} %s' % (name, label,
+                                           _fmt_value(sum_us / 1000.0)))
+
+
+def render_prometheus(snapshot, ring=None, window_secs=60.0,
+                      scrapes=None):
+    """Prometheus text exposition (0.0.4) from one metrics snapshot.
+
+    ``snapshot`` is the ``{"nodes": {id: counters}, "aggregate": {...}}``
+    shape of ``Server.metrics_snapshot()`` — the caller takes it ONCE and
+    hands it in, so the exposition is internally consistent even while
+    nodes die underneath the scrape.  ``ring`` (a :class:`SampleRing`)
+    contributes windowed rate gauges.
+    """
+    nodes = (snapshot or {}).get("nodes") or {}
+    fams = _Families()
+
+    fams.add("tfos_nodes", "gauge",
+             "Nodes currently contributing metric snapshots.",
+             "tfos_nodes %d" % len(nodes))
+    if scrapes is not None:
+        fams.add("tfos_scrapes_total", "counter",
+                 "Scrapes served by this observatory endpoint.",
+                 "tfos_scrapes_total %d" % scrapes)
+
+    for executor in sorted(nodes):
+        counters = nodes[executor]
+        if not isinstance(counters, dict):
+            continue
+        _render_histogram(fams, executor, counters)
+        for key in sorted(counters):
+            val = counters[key]
+            if isinstance(val, bool) or not isinstance(val, (int, float)):
+                continue
+            if (key.startswith(_HIST_PREFIX) or key == _HIST_COUNT
+                    or key == _HIST_SUM_US):
+                continue  # folded into the histogram family above
+            if key.endswith(_GAUGE_SUFFIXES):
+                name = _metric_name(key)
+                mtype = "gauge"
+                help_text = ("Latest %s reading reported per executor."
+                             % key)
+            else:
+                name = _metric_name(key) + "_total"
+                mtype = "counter"
+                help_text = "Cumulative %s reported per executor." % key
+            fams.add(name, mtype, help_text,
+                     '%s{executor="%s"} %s'
+                     % (name, _escape_label(executor), _fmt_value(val)))
+
+    if ring is not None:
+        for executor, node_rates in sorted(ring.rates(window_secs).items()):
+            for key in sorted(node_rates):
+                name = _metric_name(key) + "_per_sec"
+                fams.add(name, "gauge",
+                         "Windowed rate of %s (last %gs of heartbeat "
+                         "samples)." % (key, window_secs),
+                         '%s{executor="%s"} %s'
+                         % (name, _escape_label(executor),
+                            _fmt_value(node_rates[key])))
+    return fams.render()
+
+
+class ObservatoryServer(object):
+    """Dependency-free driver HTTP endpoint: ``/metrics`` + ``/status``.
+
+    ``snapshot_fn`` returns the ``{"nodes", "aggregate"}`` metrics snapshot
+    (typically ``reservation.Server.metrics_snapshot``); ``status_fn``
+    returns the JSON-ready ``/status`` extras (``tf_status``).  Both are
+    called per request on the scraper's thread — they must be cheap and
+    thread-safe, which the reservation server's copy-under-iteration
+    snapshots are.  A snapshot is taken once per scrape and rendered from
+    that copy, so mid-scrape node death yields a stale-but-consistent
+    exposition, never a torn one.
+    """
+
+    def __init__(self, snapshot_fn, ring=None, status_fn=None,
+                 host="0.0.0.0", port=0, window_secs=60.0):
+        self._snapshot_fn = snapshot_fn
+        self._status_fn = status_fn
+        self.ring = ring if ring is not None else SampleRing()
+        self._window_secs = window_secs
+        self._host = host
+        self._port = int(port)
+        self._httpd = None
+        self._thread = None
+        self._scrapes = 0
+        self.addr = None
+
+    # -- request handling --------------------------------------------------
+
+    def _metrics_text(self):
+        self._scrapes += 1
+        try:
+            snapshot = self._snapshot_fn()
+        except Exception:
+            logger.warning("observatory: snapshot failed", exc_info=True)
+            snapshot = {}
+        return render_prometheus(snapshot, ring=self.ring,
+                                 window_secs=self._window_secs,
+                                 scrapes=self._scrapes)
+
+    def _status_json(self):
+        try:
+            snapshot = self._snapshot_fn()
+        except Exception:
+            snapshot = {}
+        status = {}
+        if self._status_fn is not None:
+            try:
+                status = self._status_fn() or {}
+            except Exception:
+                status = {}
+        payload = {
+            "time": time.time(),
+            "tf_status": status,
+            "metrics_snapshot": snapshot,
+            "series_depths": self.ring.depths(),
+            "scrapes": self._scrapes,
+        }
+        # tf_status may hold arbitrary user values; never let one break
+        # the endpoint
+        return json.dumps(payload, default=str)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        """Bind + serve on a daemon thread; returns ``(host, port)``."""
+        observatory = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = observatory._metrics_text().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path in ("/status", "/status/"):
+                    body = observatory._status_json().encode("utf-8")
+                    ctype = "application/json"
+                elif path == "/":
+                    body = b"tfos observatory: /metrics /status\n"
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # quiet: no stderr per scrape
+                logger.debug("observatory: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), _Handler)
+        self._httpd.daemon_threads = True
+        self.addr = (self._host, self._httpd.server_address[1])
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        kwargs={"poll_interval": 0.2},
+                                        name="tfos-observatory", daemon=True)
+        self._thread.start()
+        logger.info("observatory serving /metrics and /status on %s:%d",
+                    self.addr[0], self.addr[1])
+        telemetry.get_tracer().instant("observatory/start",
+                                       port=self.addr[1])
+        return self.addr
+
+    def stop(self):
+        """Idempotent shutdown."""
+        httpd, self._httpd = self._httpd, None
+        if httpd is None:
+            return
+        try:
+            httpd.shutdown()
+            httpd.server_close()
+        except Exception:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

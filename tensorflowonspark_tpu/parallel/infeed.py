@@ -25,6 +25,7 @@ caps accelerator utilization.  Here each host:
    spans land on the telemetry timeline when tracing is enabled.
 """
 
+import collections
 import logging
 import os
 import queue as _queue
@@ -133,6 +134,11 @@ class ShardedFeed(object):
         self._num_processes = jax.process_count()
         self._stop = None            # prefetch stop event (set in batches())
         self._prefetch_thread = None
+        # Trace-flow relay: ids popped from the upstream feed
+        # (ServiceFeed.pop_flow_id) at device-put time, re-parked here for
+        # the trainer's dispatch leg (pop_dispatch_flow).  Best-effort,
+        # bounded; single producer (the prefetch thread), single consumer.
+        self._dispatch_flows = collections.deque(maxlen=16)
         # Ride this node's heartbeats: the metrics provider duck-types
         # counters_snapshot() over every registered source, so the infeed_*
         # tallies reach the driver's metrics_snapshot() aggregate.  Guarded:
@@ -170,6 +176,30 @@ class ShardedFeed(object):
         self._put_us += us
         if us > self._put_us_hwm:
             self._put_us_hwm = us
+
+    def _note_flow(self, leg, **attrs):
+        """Relay a committed-split trace-flow id (if the upstream feed
+        carries one) through the device-put leg to the dispatch leg."""
+        pop = getattr(self.feed, "pop_flow_id", None)
+        if pop is None:
+            return
+        try:
+            fid = pop()
+        except Exception:  # pragma: no cover - duck-typed feeds
+            return
+        if fid:
+            telemetry.get_tracer().flow_step(
+                "dataservice/split_flow", fid, leg=leg, **attrs)
+            self._dispatch_flows.append(int(fid))
+
+    def pop_dispatch_flow(self):
+        """Oldest undrained trace-flow id that reached device infeed (or
+        None); drained by ``Trainer.fit_feed`` to end the flow at the
+        dispatch leg."""
+        try:
+            return self._dispatch_flows.popleft()
+        except IndexError:
+            return None
 
     def counters_snapshot(self):
         """Flat infeed overlap counters for heartbeat payloads /
@@ -254,6 +284,7 @@ class ShardedFeed(object):
                 self._mask_sharding, mask)
         self._tally_put(start)
         self._n_batches += 1
+        self._note_flow("infeed_device_put", rows=count)
         return batch, mask
 
     # -- public iteration -------------------------------------------------
@@ -507,6 +538,7 @@ class ShardedFeed(object):
                                          np.float32)] * k)
                     self._tally_put(start)
                     self._n_batches += k
+                    self._note_flow("infeed_device_put", group=k)
                     pending = []
                     yield ("multi", stack, masks)
                 continue

@@ -266,6 +266,14 @@ class Server(MessageSocket):
         # A BYE keeps the snapshot: the final aggregate must still cover
         # nodes that finished cleanly before the driver latched it.
         self._node_metrics = {}
+        # Optional time-series sink (observatory.SampleRing duck type): each
+        # latched snapshot is also recorded as a timestamped sample so the
+        # observatory can derive rates.  Attached by cluster.run when the
+        # observatory is enabled; None costs one attribute load per latch.
+        self.sample_ring = None
+        # Executors whose HBEAT-carried trace flow was already stitched into
+        # the driver trace (one flow step per node, not one per beat).
+        self._hbeat_flow_seen = set()
 
     # -- liveness ---------------------------------------------------------
 
@@ -319,6 +327,14 @@ class Server(MessageSocket):
             self._node_metrics[executor_id] = merged
         else:
             self._node_metrics[executor_id] = metrics
+        if self.sample_ring is not None:
+            try:
+                # record the folded cumulative view, not the raw payload, so
+                # rate derivation never sees a key vanish mid-series
+                self.sample_ring.record(executor_id,
+                                        self._node_metrics[executor_id])
+            except Exception:
+                logger.debug("sample ring record failed", exc_info=True)
 
     def _beat(self, executor_id, metrics=None):
         """Record a heartbeat; False if the node was already declared dead
@@ -486,6 +502,15 @@ class Server(MessageSocket):
                 self.send(sock, {"type": "ERR", "error": str(e)})
                 return True
             self._watch(meta)
+            # Trace-context hop: the node started a flow before dialing
+            # (node.run plants "trace_flow" in its meta); stepping it here
+            # draws the Perfetto arrow node-register -> driver-admission
+            # across the process boundary.
+            flow = meta.get("trace_flow") if isinstance(meta, dict) else None
+            if flow:
+                telemetry.get_tracer().flow_step(
+                    "reservation/register_flow", flow, leg="driver_admission",
+                    executor_id=ex)
             telemetry.get_tracer().instant(
                 "reservation/register",
                 executor_id=(meta.get("executor_id")
@@ -503,6 +528,15 @@ class Server(MessageSocket):
                 self.send(sock, {"type": "ERR",
                                  "error": "HBEAT without executor_id"})
             elif self._beat(executor_id, metrics=data.get("metrics")):
+                flow = data.get("trace_flow")
+                if flow and executor_id not in self._hbeat_flow_seen:
+                    # terminate the registration flow on the FIRST beat only:
+                    # the arrow proves the heartbeat channel came up; one
+                    # event per beat would just be ring-buffer pressure
+                    self._hbeat_flow_seen.add(executor_id)
+                    telemetry.get_tracer().flow_end(
+                        "reservation/register_flow", flow, leg="first_hbeat",
+                        executor_id=executor_id)
                 self.send(sock, {"type": "OK"})
             else:
                 self.send(sock, {"type": "ERR",
@@ -687,15 +721,19 @@ class Client(MessageSocket):
             raise Exception("registration rejected: {}".format(
                 resp.get("error", resp)))
 
-    def heartbeat(self, executor_id, metrics=None):
+    def heartbeat(self, executor_id, metrics=None, trace_flow=None):
         """Send one liveness beat; returns False if the server fenced this
         node (declared dead — the caller should stop beating and may choose
         to self-terminate rather than run as a zombie).  ``metrics`` is an
         optional flat JSON dict of telemetry counters piggybacked on the
-        beat (messages are JSON-only; see module docstring)."""
+        beat (messages are JSON-only; see module docstring); ``trace_flow``
+        is an optional flow id carrying the node's registration trace
+        context (the server stitches it on the first beat)."""
         data = {"executor_id": executor_id}
         if metrics:
             data["metrics"] = metrics
+        if trace_flow:
+            data["trace_flow"] = trace_flow
         resp = self._request({"type": "HBEAT", "data": data})
         return resp.get("type") == "OK"
 
@@ -788,14 +826,18 @@ class HeartbeatSender(object):
     """
 
     def __init__(self, server_addr, executor_id, interval,
-                 metrics_provider=None):
+                 metrics_provider=None, trace_flow=None):
         """``metrics_provider``: optional zero-arg callable returning a flat
         JSON-serializable counter dict to piggyback on each beat (errors are
-        swallowed — metrics must never cost a liveness beat)."""
+        swallowed — metrics must never cost a liveness beat).
+        ``trace_flow``: optional flow id (the node's registration trace
+        context) piggybacked on beats; the server stitches the first one
+        into the driver trace."""
         self.server_addr = tuple(server_addr)
         self.executor_id = executor_id
         self.interval = interval
         self.metrics_provider = metrics_provider
+        self.trace_flow = trace_flow
         self.fenced = False
         self._stop = threading.Event()
         self._client = None
@@ -836,8 +878,9 @@ class HeartbeatSender(object):
                 except Exception as e:
                     logger.debug("heartbeat metrics provider failed: %s", e)
             try:
-                if not self._ensure_client().heartbeat(self.executor_id,
-                                                       metrics=metrics):
+                if not self._ensure_client().heartbeat(
+                        self.executor_id, metrics=metrics,
+                        trace_flow=self.trace_flow):
                     logger.error(
                         "executor %s fenced by the liveness monitor; "
                         "stopping heartbeats", self.executor_id)

@@ -5,7 +5,13 @@ Three legs, all dependency-free:
 1. **Lifecycle span tracing** — a process-local :class:`Tracer` with
    ``span(name, **attrs)`` context managers and ``instant`` events, emitting
    Chrome trace-event JSON (loadable in ``chrome://tracing`` / Perfetto).
-   One file per process: ``<dir>/trace-<host>-<pid>.json``.
+   One file per process: ``<dir>/trace-<host>-<pid>.json``.  Cross-process
+   causality rides *flow events* (``flow_start``/``flow_step``/``flow_end``,
+   Chrome ``"s"``/``"t"``/``"f"``): a ``new_flow_id()`` travels on wire
+   messages (reservation REG, data-service split assignment and stream
+   control frames) and Perfetto draws one arrow through every process that
+   touched it — dispatcher assign → worker stream → consumer commit →
+   infeed device_put → train dispatch.
 2. **Counters** — a flat ``str -> number`` map with ``counter_add`` /
    ``counter_max``; node processes snapshot them into heartbeat payloads
    (``reservation.py``), the driver aggregates with :func:`merge_counters`.
@@ -125,6 +131,18 @@ class _NullTracer(object):
     def counters_snapshot(self):
         return {}
 
+    def new_flow_id(self):
+        return 0
+
+    def flow_start(self, name, flow_id, **attrs):
+        pass
+
+    def flow_step(self, name, flow_id, **attrs):
+        pass
+
+    def flow_end(self, name, flow_id, **attrs):
+        pass
+
     def flush(self):
         pass
 
@@ -183,6 +201,7 @@ class Tracer(object):
         self._lock = threading.Lock()
         self._counters = {}
         self._dropped = 0
+        self._flow_seq = 0
         # open-span stacks per thread id, for the flight recorder
         self._open = collections.defaultdict(list)
         self._meta_emitted = False
@@ -202,6 +221,47 @@ class Tracer(object):
             "ts": time.time() * 1e6,
             "args": attrs,
         })
+
+    # -- cross-process flow events ---------------------------------------
+
+    def new_flow_id(self):
+        """A flow id unique across the cluster's processes.
+
+        Chrome trace flow events bind by ``(cat, id)``; folding the pid into
+        the id keeps two processes' concurrent flows from aliasing even
+        though each hands out sequence numbers independently.  The id is a
+        plain JSON int so it can ride any wire message.
+        """
+        self._check_fork()
+        with self._lock:
+            self._flow_seq += 1
+            return ((self._pid & 0x3FFFFF) << 20) | (self._flow_seq & 0xFFFFF)
+
+    def _flow(self, ph, name, flow_id, attrs):
+        event = {
+            "ph": ph,
+            "name": name,
+            "cat": "tfos_flow",
+            "id": int(flow_id),
+            "ts": time.time() * 1e6,
+            "args": attrs,
+        }
+        if ph == "f":
+            event["bp"] = "e"  # bind to the enclosing slice, not the next
+        self._emit(event)
+
+    def flow_start(self, name, flow_id, **attrs):
+        """Begin a cross-process flow arrow (Chrome ``"s"``)."""
+        self._flow("s", name, flow_id, attrs)
+
+    def flow_step(self, name, flow_id, **attrs):
+        """Intermediate hop of a flow (Chrome ``"t"``); same ``name`` and
+        ``flow_id`` as the start, possibly in a different process."""
+        self._flow("t", name, flow_id, attrs)
+
+    def flow_end(self, name, flow_id, **attrs):
+        """Terminate a flow (Chrome ``"f"``, enclosing-slice binding)."""
+        self._flow("f", name, flow_id, attrs)
 
     def _check_fork(self):
         """Re-home after a fork: the child inherits this tracer (module
@@ -255,7 +315,14 @@ class Tracer(object):
 
     def counters_snapshot(self):
         with self._lock:
-            return dict(self._counters)
+            snap = dict(self._counters)
+            # Surface ring-buffer truncation on the heartbeat channel so a
+            # silently-clipped trace is visible in metrics_snapshot(), not
+            # just inside the file nobody opened.  Only when nonzero: the
+            # healthy case stays byte-identical to the pre-existing shape.
+            if self._dropped:
+                snap["events_dropped"] = self._dropped
+            return snap
 
     # -- output ----------------------------------------------------------
 

@@ -292,19 +292,109 @@ class Trainer(object):
         self._dispatch_count = 0
         self._dispatch_gap_us = 0
         self._dispatch_gap_us_hwm = 0
+        # Runtime goodput accountant (observability tier): wall time
+        # attributed to productive dispatch vs infeed starvation vs
+        # checkpoint drain vs recovery, plus a bucketed step-time histogram
+        # and achieved-FLOP/s / MFU gauges.  Step timing comes from
+        # TimeHistory's SYNCED window boundaries (dispatch wall alone
+        # measures dispatch rate, not device time — see TimeHistory), so
+        # the gauges agree with the bench-side MFU computation by
+        # construction: both call metrics.mfu_from_step_time on the same
+        # step_flops and a device-synced clock.
+        self._goodput_dispatch_us = 0
+        self._goodput_infeed_starved_us = 0
+        self._goodput_ckpt_drain_us = 0
+        self._goodput_recovery_us = 0
+        self._last_drain_us = 0
+        self._step_ms_hist = {}      # bucket bound (ms) -> window steps
+        self._step_ms_count = 0      # steps covered by closed windows
+        self._step_ms_sum_us = 0     # wall us covered by closed windows
+        self._mfu_pct = None         # latest closed window's MFU, percent
+        self._flops_per_sec = None   # latest achieved per-device FLOP/s
+        self._acct_history = None    # TimeHistory the accountant follows
+        self._windows_seen = 0       # timestamp_log entries consumed
 
     def counters_snapshot(self):
-        """Flat overlap counters for heartbeat payloads /
+        """Flat overlap + goodput counters for heartbeat payloads /
         :func:`~tensorflowonspark_tpu.telemetry.merge_counters`:
         ``dispatch_count`` dispatches, ``dispatch_gap_us`` total host-side
         time between dispatches (feed wait + checkpoint hook + bookkeeping;
         device idle time when steps don't pipeline), ``dispatch_gap_us_hwm``
-        the worst single gap."""
-        return {
+        the worst single gap.
+
+        Goodput breakdown (all wall microseconds): ``goodput_dispatch_us``
+        time inside dispatch calls, ``goodput_infeed_starved_us`` the
+        between-dispatch gap net of checkpoint-hook time (waiting on the
+        feed), ``goodput_ckpt_drain_us`` time inside the ``on_steps`` hook,
+        ``goodput_recovery_us`` restore + retry-backoff time (written by
+        :func:`fit_supervised`).  ``step_ms_le_<bound>`` /``step_ms_count``
+        /``step_ms_sum_us`` form a cumulative step-time histogram over
+        :data:`~tensorflowonspark_tpu.metrics.STEP_MS_BUCKETS`;
+        ``train_mfu_pct_max`` / ``train_flops_per_sec_max`` are the latest
+        window's gauges (``_max`` suffix -> merged by max, rendered as
+        Prometheus gauges)."""
+        snap = {
             "dispatch_count": self._dispatch_count,
             "dispatch_gap_us": self._dispatch_gap_us,
             "dispatch_gap_us_hwm": self._dispatch_gap_us_hwm,
         }
+        if self._step_ms_count:
+            running = 0
+            for bound in metrics_mod.STEP_MS_BUCKETS:
+                running += self._step_ms_hist.get(bound, 0)
+                snap["step_ms_le_%s" % bound] = running
+            snap["step_ms_count"] = self._step_ms_count
+            snap["step_ms_sum_us"] = self._step_ms_sum_us
+        for key, val in (
+                ("goodput_dispatch_us", self._goodput_dispatch_us),
+                ("goodput_infeed_starved_us", self._goodput_infeed_starved_us),
+                ("goodput_ckpt_drain_us", self._goodput_ckpt_drain_us),
+                ("goodput_recovery_us", self._goodput_recovery_us)):
+            if val:
+                snap[key] = val
+        if self._mfu_pct is not None:
+            snap["train_mfu_pct_max"] = round(self._mfu_pct, 4)
+        if self._flops_per_sec is not None:
+            snap["train_flops_per_sec_max"] = self._flops_per_sec
+        return snap
+
+    def _account_windows(self):
+        """Fold newly-closed TimeHistory windows into the step-time
+        histogram and the MFU / achieved-FLOP/s gauges.  Window boundaries
+        carry a forced device sync (see TimeHistory), so the per-step time
+        derived here is honest under async dispatch — the same clock the
+        bench-side ``build_stats`` MFU uses."""
+        hist = self.history
+        if hist is None:
+            return
+        if hist is not self._acct_history:
+            # reset_history / first use: start from this recorder's origin
+            self._acct_history = hist
+            self._windows_seen = 1
+        log = hist.timestamp_log
+        while self._windows_seen < len(log):
+            s0, t0 = log[self._windows_seen - 1]
+            s1, t1 = log[self._windows_seen]
+            self._windows_seen += 1
+            steps, span = s1 - s0, t1 - t0
+            if steps <= 0 or span <= 0:
+                continue
+            step_s = span / steps
+            step_ms = step_s * 1e3
+            for bound in metrics_mod.STEP_MS_BUCKETS:
+                if step_ms <= bound:
+                    self._step_ms_hist[bound] = (
+                        self._step_ms_hist.get(bound, 0) + steps)
+                    break
+            self._step_ms_count += steps
+            self._step_ms_sum_us += int(span * 1e6)
+            flops_ps = metrics_mod.achieved_flops_per_sec(
+                hist.step_flops, step_s)
+            if flops_ps is not None:
+                self._flops_per_sec = flops_ps
+            mfu = metrics_mod.mfu_from_step_time(hist.step_flops, step_s)
+            if mfu is not None:
+                self._mfu_pct = 100.0 * mfu
 
     def _get_multi_step(self, k):
         """Jitted program running ``k`` train steps in ONE dispatch via
@@ -551,6 +641,12 @@ class Trainer(object):
             source = sharded_feed.grouped_batches(steps_per_call)
         else:
             source = (("single", b, m) for b, m in sharded_feed.batches())
+        # Cross-process flow: a data-service feed hands over the flow id of
+        # the split a dispatched batch came from (see ServiceFeed /
+        # ShardedFeed ``pop_dispatch_flow``); ending the flow here gives
+        # Perfetto the full worker-serve -> commit -> infeed -> dispatch
+        # chain.  Duck-typed and optional — plain feeds have no flows.
+        pop_flow = getattr(sharded_feed, "pop_dispatch_flow", None)
         prev_return = None
         for kind, batch, mask in source:
             start = time.perf_counter()
@@ -559,6 +655,10 @@ class Trainer(object):
                 self._dispatch_gap_us += gap_us
                 if gap_us > self._dispatch_gap_us_hwm:
                     self._dispatch_gap_us_hwm = gap_us
+                # Goodput: the slice of the gap not spent in the previous
+                # iteration's on_steps hook was spent waiting on the feed.
+                self._goodput_infeed_starved_us += max(
+                    0, gap_us - self._last_drain_us)
             with tracer.span("train/dispatch", kind=kind), \
                     _transfer_guard_ctx(guard_level):
                 if kind == "multi":
@@ -569,10 +669,24 @@ class Trainer(object):
                     loss, _ = self.step(batch, mask)
                     steps_done += 1
             prev_return = time.perf_counter()
+            self._goodput_dispatch_us += int((prev_return - start) * 1e6)
             self._dispatch_count += 1
+            self._account_windows()
+            if pop_flow is not None:
+                fid = pop_flow()
+                if fid:
+                    tracer.flow_end("dataservice/split_flow", fid,
+                                    leg="train_dispatch", kind=kind,
+                                    steps_done=steps_done)
             last_loss = loss
             if on_steps is not None:
+                drain_t0 = time.perf_counter()
                 on_steps(steps_done)
+                self._last_drain_us = int(
+                    (time.perf_counter() - drain_t0) * 1e6)
+                self._goodput_ckpt_drain_us += self._last_drain_us
+            else:
+                self._last_drain_us = 0
             if max_steps and steps_done >= max_steps:
                 # Early stop with epochs of data still queued: drain it so
                 # blocked feed tasks unblock and the driver stops scheduling
@@ -683,8 +797,11 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
 
     try:
         for attempt in range(policy.max_attempts):
+            restore_t0 = time.perf_counter()
             with tracer.span("train/restore", attempt=attempt + 1):
                 restored = trainer.restore_latest(ckpt_manager, validate=True)
+            trainer._goodput_recovery_us += int(
+                (time.perf_counter() - restore_t0) * 1e6)
             if restored is not None:
                 logger.info("supervised fit: resuming from checkpoint step %d",
                             restored)
@@ -715,6 +832,8 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
                 tracer.instant("train/retry", attempt=attempt + 1,
                                delay_secs=delay, error=repr(e))
                 time.sleep(delay)
+                # Backoff is pure recovery wall time: the devices sit idle.
+                trainer._goodput_recovery_us += int(delay * 1e6)
         raise AssertionError("unreachable")  # pragma: no cover
     finally:
         node_mod.remove_preemption_callback(_emergency_save)

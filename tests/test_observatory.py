@@ -1,0 +1,294 @@
+"""Observatory tests: Prometheus exposition conformance, scrape consistency
+under node death, and runtime-MFU vs bench-MFU agreement (CPU mesh)."""
+
+import json
+import re
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensorflowonspark_tpu import metrics as metrics_mod
+from tensorflowonspark_tpu import observatory
+from tensorflowonspark_tpu.train import Trainer
+from tensorflowonspark_tpu.parallel import build_mesh, batch_sharding
+
+# text exposition 0.0.4: metric names and one sample line
+NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+SAMPLE_RE = re.compile(
+    r'([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?[0-9.e+-]+|[+-]Inf|NaN)\Z')
+
+
+def _parse_exposition(text):
+    """Returns (families, samples): families maps name -> type, samples is
+    [(family_name, line)] in exposition order.  Raises AssertionError on any
+    line that is neither a well-formed comment nor a well-formed sample."""
+    families = {}
+    helped = set()
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert NAME_RE.match(name), line
+            helped.add(name)
+        elif line.startswith("# TYPE "):
+            parts = line.split()
+            name, mtype = parts[2], parts[3]
+            assert NAME_RE.match(name), line
+            assert mtype in ("counter", "gauge", "histogram"), line
+            assert name not in families, "duplicate TYPE for %s" % name
+            families[name] = mtype
+        else:
+            m = SAMPLE_RE.match(line)
+            assert m, "unparseable sample line: %r" % line
+            samples.append((m.group(1), line))
+    assert helped == set(families), "HELP/TYPE mismatch"
+    return families, samples
+
+
+def _family_of(sample_name, families):
+    """Histogram samples use _bucket/_count/_sum suffixes on the family."""
+    for suffix in ("_bucket", "_count", "_sum"):
+        if sample_name.endswith(suffix) and sample_name[:-len(suffix)] \
+                in families:
+            return sample_name[:-len(suffix)]
+    return sample_name
+
+
+SNAPSHOT = {
+    "nodes": {
+        "executor-0": {
+            "chunks": 41, "rows": 820, "depth_hwm": 7,
+            "dispatch_gap_us": 1200, "dispatch_gap_us_hwm": 300,
+            "train_mfu_pct_max": 37.5, "train_flops_per_sec_max": 3.7e10,
+            "goodput_dispatch_us": 900000, "goodput_infeed_starved_us": 1000,
+            "step_ms_le_5": 3, "step_ms_le_10": 9, "step_ms_le_25": 9,
+            "step_ms_count": 10, "step_ms_sum_us": 88000,
+            "weird key!": 5,           # name needs sanitizing
+            "ignored_str": "not-a-number",
+        },
+        "executor-1": {"chunks": 7, "events_dropped": 2},
+    },
+    "aggregate": {"chunks": 48},
+}
+
+
+class TestPrometheusConformance:
+    def test_exposition_parses_and_types_are_correct(self):
+        text = observatory.render_prometheus(SNAPSHOT, scrapes=3)
+        families, samples = _parse_exposition(text)
+        # counter vs gauge typing follows the _hwm/_max suffix convention
+        assert families["tfos_chunks_total"] == "counter"
+        assert families["tfos_events_dropped_total"] == "counter"
+        assert families["tfos_depth_hwm"] == "gauge"
+        assert families["tfos_dispatch_gap_us_hwm"] == "gauge"
+        assert families["tfos_train_mfu_pct_max"] == "gauge"
+        assert families["tfos_nodes"] == "gauge"
+        assert families["tfos_scrapes_total"] == "counter"
+        assert families["tfos_step_ms"] == "histogram"
+        # every counter family name carries the _total suffix
+        for name, mtype in families.items():
+            if mtype == "counter":
+                assert name.endswith("_total"), name
+        # sanitized name made it through, string value did not
+        assert "tfos_weird_key__total" in families
+        assert "ignored_str" not in text
+
+    def test_family_samples_are_contiguous(self):
+        text = observatory.render_prometheus(SNAPSHOT, scrapes=1)
+        families, samples = _parse_exposition(text)
+        seen_done = set()
+        current = None
+        for sample_name, _ in samples:
+            fam = _family_of(sample_name, families)
+            assert fam in families, sample_name
+            if fam != current:
+                assert fam not in seen_done, \
+                    "family %s interleaved" % fam
+                if current is not None:
+                    seen_done.add(current)
+                current = fam
+
+    def test_histogram_is_cumulative_with_inf_bucket(self):
+        text = observatory.render_prometheus(SNAPSHOT)
+        bucket_re = re.compile(
+            r'tfos_step_ms_bucket\{executor="executor-0",le="([^"]+)"\} '
+            r'(\d+)')
+        buckets = bucket_re.findall(text)
+        assert buckets, text
+        assert buckets[-1][0] == "+Inf"
+        counts = [int(c) for _, c in buckets]
+        assert counts == sorted(counts), "buckets not cumulative"
+        count_re = re.compile(
+            r'tfos_step_ms_count\{executor="executor-0"\} (\d+)')
+        assert int(count_re.search(text).group(1)) == counts[-1] == 10
+        # sum is milliseconds (counters carry microseconds)
+        assert 'tfos_step_ms_sum{executor="executor-0"} 88.0' in text
+
+    def test_ring_rates_skip_gauges_and_clamp_resets(self):
+        import time as _time
+        ring = observatory.SampleRing()
+        now = _time.time()
+        ring.record("n0", {"chunks": 100, "depth_hwm": 9}, ts=now - 10)
+        ring.record("n0", {"chunks": 40, "depth_hwm": 5}, ts=now)
+        rates = ring.rates(window_secs=60.0)
+        # counter reset (restart) clamps to zero, never negative
+        assert rates["n0"]["chunks"] == 0.0
+        # gauges have no meaningful rate
+        assert "depth_hwm" not in rates["n0"]
+
+
+class TestScrapeDuringNodeDeath:
+    def test_concurrent_scrapes_stay_consistent(self):
+        """Nodes appearing/dying between and during scrapes must never
+        produce a torn or unparseable exposition."""
+        full = dict(SNAPSHOT["nodes"])
+        state = {"nodes": dict(full), "aggregate": {}}
+        lock = threading.Lock()
+
+        def snapshot_fn():
+            with lock:
+                return {"nodes": dict(state["nodes"]), "aggregate": {}}
+
+        srv = observatory.ObservatoryServer(
+            snapshot_fn, status_fn=lambda: {"state": "running"},
+            host="127.0.0.1")
+        host, port = srv.start()
+        stop = threading.Event()
+
+        def churn():
+            flip = False
+            while not stop.is_set():
+                with lock:
+                    state["nodes"] = ({"executor-1": full["executor-1"]}
+                                      if flip else dict(full))
+                flip = not flip
+
+        churner = threading.Thread(target=churn, daemon=True)
+        churner.start()
+        try:
+            base = "http://%s:%d" % (host, port)
+            for _ in range(25):
+                text = urllib.request.urlopen(
+                    base + "/metrics", timeout=5).read().decode()
+                families, _ = _parse_exposition(text)
+                n = int(re.search(r"tfos_nodes (\d+)", text).group(1))
+                assert n in (1, 2)
+                # one consistent snapshot per scrape: tfos_chunks_total
+                # has exactly n executor samples
+                assert text.count("tfos_chunks_total{") == n
+                status = json.loads(urllib.request.urlopen(
+                    base + "/status", timeout=5).read().decode())
+                assert status["tf_status"] == {"state": "running"}
+                assert len(status["metrics_snapshot"]["nodes"]) in (1, 2)
+        finally:
+            stop.set()
+            churner.join(timeout=2)
+            srv.stop()
+
+    def test_snapshot_failure_yields_valid_exposition(self):
+        def bad_snapshot():
+            raise RuntimeError("node registry torn down")
+
+        srv = observatory.ObservatoryServer(bad_snapshot, host="127.0.0.1")
+        host, port = srv.start()
+        try:
+            text = urllib.request.urlopen(
+                "http://%s:%d/metrics" % (host, port),
+                timeout=5).read().decode()
+        finally:
+            srv.stop()
+        _parse_exposition(text)
+        assert "tfos_nodes 0" in text
+
+
+def _linear_loss(params, batch, mask):
+    pred = batch["x"] @ params["w"] + params["b"]
+    err = (pred - batch["y"]) ** 2 * mask
+    return err.sum() / jnp.maximum(mask.sum(), 1.0), pred
+
+
+class TestRuntimeMfuAgreement:
+    def test_runtime_mfu_matches_bench_formula_within_5pct(self):
+        """The Trainer's runtime MFU gauge must agree with the bench's MFU
+        computation (TimeHistory.mfu over a closed window) within 5% on a
+        tiny jitted step — they share formula AND clock, so disagreement
+        means the accountant folded the wrong window."""
+        mesh = build_mesh()
+        # a matmul big enough that a 5-step window is not pure noise
+        rng = np.random.RandomState(0)
+        x = rng.rand(256, 128).astype(np.float32)
+        w = jnp.zeros((128, 1))
+
+        def loss_fn(params, batch, mask):
+            pred = (batch["x"] @ params["w"])[:, 0]
+            err = (pred - batch["y"]) ** 2 * mask
+            return err.sum() / jnp.maximum(mask.sum(), 1.0), pred
+
+        sharding = batch_sharding(mesh)
+        batch = {"x": jax.device_put(x, sharding),
+                 "y": jax.device_put(rng.rand(256).astype(np.float32),
+                                     sharding)}
+        tr = Trainer(loss_fn, {"w": w}, optax.sgd(0.01), mesh=mesh,
+                     batch_size=256, log_steps=5)
+        # bench procedure (_run_synthetic_leg): warm up, reset, measure
+        for _ in range(3):
+            tr.step(batch)
+        tr.reset_history()
+        for _ in range(20):
+            loss, _ = tr.step(batch)
+        tr._account_windows()
+        snap = tr.counters_snapshot()
+        assert snap.get("train_mfu_pct_max") is not None, snap
+        runtime_mfu = snap["train_mfu_pct_max"] / 100.0
+
+        log = tr.history.timestamp_log
+        assert len(log) >= 2, log
+        (s0, t0), (s1, t1) = log[-2], log[-1]
+        bench_mfu = tr.history.mfu((t1 - t0) / (s1 - s0))
+        assert bench_mfu is not None
+        assert runtime_mfu == pytest.approx(bench_mfu, rel=0.05)
+        # achieved FLOP/s gauge agrees with the same window too
+        assert snap["train_flops_per_sec_max"] == pytest.approx(
+            metrics_mod.achieved_flops_per_sec(
+                tr.history.step_flops, (t1 - t0) / (s1 - s0)), rel=0.05)
+        # histogram accounting covered every closed-window step
+        assert snap["step_ms_count"] == s1
+        bucket_keys = [k for k in snap if k.startswith("step_ms_le_")]
+        assert bucket_keys
+        bounds = sorted(int(k[len("step_ms_le_"):]) for k in bucket_keys)
+        cum = [snap["step_ms_le_%s" % b] for b in bounds]
+        assert cum == sorted(cum), "cumulative buckets must be monotone"
+        assert cum[-1] <= snap["step_ms_count"]
+
+    def test_whole_run_mfu_same_ballpark(self):
+        """build_stats' whole-run mfu (what bench.py publishes) and the
+        runtime gauge's latest-window mfu measure the same steady loop —
+        generous 2x band only to absorb CPU scheduler jitter."""
+        mesh = build_mesh()
+        params = {"w": jnp.zeros((2,)), "b": jnp.zeros(())}
+        tr = Trainer(_linear_loss, params, optax.sgd(0.01), mesh=mesh,
+                     batch_size=64, log_steps=5)
+        batch = {"x": jnp.ones((64, 2)), "y": jnp.ones((64,))}
+        for _ in range(3):
+            tr.step(batch)
+        tr.reset_history()
+        loss = None
+        for _ in range(20):
+            loss, _ = tr.step(batch)
+        tr.history.on_train_end(loss)
+        tr._account_windows()
+        stats = tr.history.build_stats(loss=float(loss))
+        snap = tr.counters_snapshot()
+        if "mfu" not in stats or snap.get("train_mfu_pct_max") is None:
+            pytest.skip("no step_flops on this backend")
+        runtime = snap["train_mfu_pct_max"] / 100.0
+        assert stats["mfu"] / 2 <= runtime <= stats["mfu"] * 2, \
+            (stats["mfu"], runtime)

@@ -223,9 +223,24 @@ def _run_synthetic_leg(trainer, batch, mask, k, steps, stats_path, chief,
     # checkable on a live /metrics scrape.
     trainer._account_windows()
     acct = {k: v for k, v in trainer.counters_snapshot().items()
-            if k.startswith(("train_", "step_ms"))}
+            if k.startswith(("train_", "step_ms", "attrib_"))}
     if acct:
         stats["runtime_accountant"] = acct
+    # Roofline view of the same leg: how close did the measured step come
+    # to the memory/compute-bound ceiling (1.0 = at the roofline wall),
+    # not just to peak FLOPs as plain mfu reports.  Absent when cost
+    # analysis could not supply bytes (step_flops_override path).
+    roof = dict(trainer._roofline or {})
+    if trainer._step_bytes:
+        roof["bytes_accessed"] = trainer._step_bytes
+    if trainer._compile_secs is not None:
+        roof["compile_secs"] = round(trainer._compile_secs, 3)
+    ideal = roof.get("ideal_step_seconds")
+    avg_step = stats.get("avg_step_seconds")
+    if ideal and avg_step:
+        roof["roofline_frac"] = round(ideal / avg_step, 4)
+    if roof:
+        stats["roofline"] = roof
     if extra:
         stats.update(extra)
     if chief:
@@ -909,6 +924,19 @@ def main():
         # the config the leg itself recorded (build_lm_trainer is the one
         # source of truth); None when the leg didn't run
         "transformer_lm_config": lm.get("config") if lm else None,
+        # roofline view of the two compute legs: achieved fraction of the
+        # memory/compute-bound ceiling (1.0 = at the wall — a tighter bar
+        # than mfu's fraction-of-peak) plus step-fn compile wall time.
+        # None when the leg replayed from a pre-roofline round or cost
+        # analysis couldn't supply bytes (step_flops_override path).
+        "resnet50_roofline_frac":
+            ((resnet or {}).get("roofline") or {}).get("roofline_frac"),
+        "resnet50_compile_secs":
+            ((resnet or {}).get("roofline") or {}).get("compile_secs"),
+        "transformer_lm_roofline_frac":
+            ((lm or {}).get("roofline") or {}).get("roofline_frac"),
+        "transformer_lm_compile_secs":
+            ((lm or {}).get("roofline") or {}).get("compile_secs"),
     }
     if feedplane:
         out["feed_plane_images_per_sec"] = round(

@@ -35,12 +35,17 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # checkpoint saves, and dispatch-gap counters reaching the driver, then
 # prove the observatory answers live: /metrics + /status scrapeable
 # mid-run with the MFU/goodput accountant, counters monotone, and trace
-# flow events linking a data-service split to a consumer-side dispatch
+# flow events linking a data-service split to a consumer-side dispatch,
+# then prove the device plane explains itself: attribution gauges on
+# /metrics summing to ~100%, a mid-run GET /profile collecting every
+# node's device trace to the driver, and analyze_profile.py merging them
+# with the host traces into one Perfetto timeline
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
 python scripts/ci_assert_dataservice.py
 python scripts/ci_assert_overlap.py
 python scripts/ci_assert_observatory.py
+python scripts/ci_assert_profiling.py
 
 exit $rc

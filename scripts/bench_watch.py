@@ -288,6 +288,12 @@ HEADLINE_METRICS = (
     ("transformer_lm_train_mfu", "transformer", "higher"),
     ("transformer_lm_step_time_ms", "transformer", "lower"),
     ("feed_plane_images_per_sec", "feed_plane", "higher"),
+    # roofline accountant keys (absent in pre-PR8 rounds: run_diff skips
+    # metrics missing on either side, so old baselines stay comparable)
+    ("resnet50_roofline_frac", "resnet", "higher"),
+    ("resnet50_compile_secs", "resnet", "lower"),
+    ("transformer_lm_roofline_frac", "transformer", "higher"),
+    ("transformer_lm_compile_secs", "transformer", "lower"),
 )
 
 

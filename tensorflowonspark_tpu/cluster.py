@@ -43,7 +43,8 @@ class TPUCluster(object):
     ``TFCluster.py:29-207``)."""
 
     def __init__(self, backend, cluster_meta, cluster_info, input_mode,
-                 server, start_job, tf_status, queues, observatory=None):
+                 server, start_job, tf_status, queues, observatory=None,
+                 profiling=None):
         self.backend = backend
         self.cluster_meta = cluster_meta
         self.cluster_info = cluster_info
@@ -56,6 +57,10 @@ class TPUCluster(object):
         # True)): live /metrics + /status HTTP endpoint; stopped with the
         # cluster on every shutdown path (see _latch_telemetry)
         self.observatory = observatory
+        # optional profiling.CaptureCoordinator (rides the observatory
+        # flag): .trigger() captures device traces from the driver without
+        # going through HTTP; artifacts land under <log_dir>/profiles
+        self.profiling = profiling
 
     # -- data plane -------------------------------------------------------
 
@@ -694,8 +699,10 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
     server_addr = server.start()
 
     obs = None
+    profiling_coord = None
     if observatory:
         from tensorflowonspark_tpu import observatory as observatory_mod
+        from tensorflowonspark_tpu import profiling as profiling_mod
 
         # Sample ring first: the server records a timestamped copy of each
         # node's folded counters on every metrics-bearing beat, so the
@@ -704,11 +711,28 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         # mid-node-death.
         ring = observatory_mod.SampleRing()
         server.sample_ring = ring
+        # On-demand device-trace captures: GET /profile fans out through
+        # the heartbeat channel and artifacts land under <log_dir>/profiles.
+        profiling_coord = profiling_mod.CaptureCoordinator(
+            server, os.path.abspath(
+                os.path.join(log_dir or ".", "profiles")))
+        server.profile_coordinator = profiling_coord
+
+        def _profiler_addresses():
+            # lazy: the observatory starts before the roster exists, and the
+            # roster can change on replacement admission
+            return ["{}:{}".format(m.get("host"), m.get("profiler_port"))
+                    for m in server.reservations.get()
+                    if isinstance(m, dict) and m.get("profiler_port")]
+
         obs = observatory_mod.ObservatoryServer(
             server.metrics_snapshot, ring=ring,
-            status_fn=lambda: tf_status, port=observatory_port)
+            status_fn=lambda: tf_status, port=observatory_port,
+            profile_fn=profiling_coord.trigger,
+            profiler_addresses_fn=_profiler_addresses,
+            capture_status_fn=profiling_coord.status)
         addr = obs.start()
-        logger.info("observatory serving /metrics and /status at "
+        logger.info("observatory serving /metrics, /status and /profile at "
                     "http://%s:%d", addr[0], addr[1])
 
     # Normalize the data-service spec to {"dispatcher": [host, port]} for
@@ -812,4 +836,4 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
 
     return TPUCluster(cluster_backend, cluster_meta, cluster_info, input_mode,
                       server, start_job, tf_status, tuple(queues),
-                      observatory=obs)
+                      observatory=obs, profiling=profiling_coord)

@@ -9,6 +9,7 @@ in terms of (BASELINE.md: >=50% MFU on v5e-16).
 
 import json
 import logging
+import sys
 import time
 
 logger = logging.getLogger(__name__)
@@ -27,6 +28,24 @@ PEAK_FLOPS = {
     "tpu v6 lite": 918e12,   # v6e / trillium
     "tpu v6e": 918e12,
     "cpu": 1e11,             # nominal figure so tests exercise the math
+}
+
+# Peak HBM bytes/s per chip for roofline accounting — same keying rules as
+# PEAK_FLOPS (full lowercased ``device_kind``, exact match).  Together the
+# two tables define the ridge point peak_flops/peak_bw: a step fn whose
+# arithmetic intensity (flops / bytes accessed) sits below it is
+# memory-bound and its honest ceiling is bw * intensity, not peak flops.
+PEAK_BYTES_PER_SEC = {
+    "tpu v2": 700e9,
+    "tpu v3": 900e9,
+    "tpu v4": 1228e9,
+    "tpu v5 lite": 819e9,
+    "tpu v5e": 819e9,
+    "tpu v5": 2765e9,        # v5p
+    "tpu v5p": 2765e9,
+    "tpu v6 lite": 1640e9,   # v6e / trillium
+    "tpu v6e": 1640e9,
+    "cpu": 5e10,             # nominal figure so tests exercise the math
 }
 
 
@@ -70,6 +89,17 @@ def peak_flops_per_device():
     return val
 
 
+def peak_bytes_per_sec_per_device():
+    import jax
+
+    kind = getattr(jax.devices()[0], "device_kind", "cpu").lower()
+    val = PEAK_BYTES_PER_SEC.get(kind)
+    if val is None:
+        logger.warning(
+            "unknown device kind %r; roofline will be reported as None", kind)
+    return val
+
+
 def estimate_step_flops(jitted_fn, *args, **kwargs):
     """Per-device FLOPs of one compiled step from XLA's cost analysis
     (falls back to None).
@@ -77,15 +107,149 @@ def estimate_step_flops(jitted_fn, *args, **kwargs):
     XLA reports the cost of the post-SPMD-partitioning per-device module, so
     on an N-device mesh this is ~1/N of the global step FLOPs — pair it with
     the per-device peak (see :meth:`TimeHistory.mfu`)."""
+    return estimate_step_cost(jitted_fn, *args, **kwargs)["flops"]
+
+
+def estimate_step_cost(jitted_fn, *args, **kwargs):
+    """Cost-analyze one compiled step: per-device FLOPs, bytes accessed,
+    and the lower+compile wall time.
+
+    Returns ``{"flops": float|None, "bytes_accessed": float|None,
+    "compile_secs": float}``.  ``bytes accessed`` (the XLA key has a space)
+    is the cost model's total HBM traffic for the per-device module — the
+    denominator of the arithmetic intensity :func:`roofline` classifies on.
+    Both figures fall back to None when the backend has no cost model;
+    ``compile_secs`` is always real (it times the lower+compile even on a
+    failure path, where it reports the time spent failing)."""
+    t0 = time.perf_counter()
     try:
         compiled = jitted_fn.lower(*args, **kwargs).compile()
+        compile_secs = time.perf_counter() - t0
         cost = compiled.cost_analysis()
         if isinstance(cost, list):  # older jax returns [dict]
             cost = cost[0]
-        return float(cost.get("flops", 0.0)) or None
+        return {
+            "flops": float(cost.get("flops", 0.0)) or None,
+            "bytes_accessed": float(cost.get("bytes accessed", 0.0)) or None,
+            "compile_secs": compile_secs,
+        }
     except Exception:
         logger.warning("cost analysis unavailable", exc_info=True)
+        return {"flops": None, "bytes_accessed": None,
+                "compile_secs": time.perf_counter() - t0}
+
+
+def roofline(step_flops, bytes_accessed, peak_flops=None, peak_bps=None):
+    """Roofline classification of one step fn.
+
+    Args are per-device figures (XLA cost analysis reports the partitioned
+    module).  ``peak_flops``/``peak_bps`` default to the local device's
+    table entries.  Returns None when any input is unknowable, else::
+
+        {"arithmetic_intensity": flops/byte,
+         "ridge_point":          peak_flops / peak_bps (flops/byte),
+         "bound":                "memory" | "compute",
+         "ceiling_flops_per_sec": min(peak_flops, intensity * peak_bps),
+         "ideal_step_seconds":   step_flops / ceiling}
+
+    ``ideal_step_seconds`` is the time the device MUST spend on this step
+    at the roofline ceiling — the device-compute bucket of the attribution
+    report; everything a measured step takes beyond it is starvation,
+    drain, collective time, or device inefficiency.
+    """
+    if peak_flops is None:
+        peak_flops = peak_flops_per_device()
+    if peak_bps is None:
+        peak_bps = peak_bytes_per_sec_per_device()
+    if not step_flops or not bytes_accessed or not peak_flops or not peak_bps:
         return None
+    intensity = step_flops / bytes_accessed
+    ridge = peak_flops / peak_bps
+    ceiling = min(peak_flops, intensity * peak_bps)
+    return {
+        "arithmetic_intensity": intensity,
+        "ridge_point": ridge,
+        "bound": "memory" if intensity < ridge else "compute",
+        "ceiling_flops_per_sec": ceiling,
+        "ideal_step_seconds": step_flops / ceiling,
+    }
+
+
+def device_memory_counters():
+    """Per-device peak-memory high-water marks as heartbeat counters.
+
+    Reads ``device.memory_stats()`` across local devices; the max over
+    devices of ``bytes_in_use`` and ``peak_bytes_in_use`` land as
+    ``device_mem_bytes_in_use_hwm`` / ``device_mem_peak_bytes_hwm``
+    (``_hwm`` suffix -> merged by max, rendered as gauges).  Backends
+    without memory stats (CPU) contribute ``{}`` — callers must not rely
+    on the keys existing.
+
+    This runs on the heartbeat thread, so it must never be the thing that
+    pays JAX startup: importing jax (~0.5s) or first-touch backend init
+    (seconds on TPU) would stall the beat past the liveness tolerance and
+    fence a healthy node.  Processes that never initialized JAX contribute
+    ``{}``; ones that did (the trainer) get stats for free."""
+    out = {}
+    try:
+        jax = sys.modules.get("jax")
+        if jax is None:
+            return out
+        xb = sys.modules.get("jax._src.xla_bridge")
+        if xb is None or not getattr(xb, "_backends", None):
+            return out  # no backend up yet; local_devices() would init one
+
+        in_use, peak = 0, 0
+        seen = False
+        for dev in jax.local_devices():
+            stats = getattr(dev, "memory_stats", lambda: None)()
+            if not isinstance(stats, dict):
+                continue
+            seen = True
+            in_use = max(in_use, int(stats.get("bytes_in_use", 0)))
+            peak = max(peak, int(stats.get("peak_bytes_in_use",
+                                           stats.get("bytes_in_use", 0))))
+        if seen:
+            out["device_mem_bytes_in_use_hwm"] = in_use
+            out["device_mem_peak_bytes_hwm"] = peak
+    except Exception:  # metrics must never cost a heartbeat
+        logger.debug("device memory stats unavailable", exc_info=True)
+    return out
+
+
+#: Attribution bucket names, in report order.  The buckets decompose one
+#: measured wall duration on the step loop and always sum to 100%.
+ATTRIBUTION_BUCKETS = ("device_compute", "collective", "infeed_starved",
+                       "ckpt_drain", "unattributed")
+
+
+def attribute_step_time(measured_us, device_compute_us, collective_us=0.0,
+                        infeed_starved_us=0.0, ckpt_drain_us=0.0):
+    """Decompose ``measured_us`` of step-loop wall time into percentage
+    buckets that sum to exactly 100.
+
+    ``device_compute_us`` is the roofline-ideal device time
+    (steps * :func:`roofline` ``ideal_step_seconds``); ``collective_us``
+    estimated communication time; ``infeed_starved_us``/``ckpt_drain_us``
+    the goodput counters.  The remainder is ``unattributed`` — device
+    inefficiency plus host overhead the other buckets can't see.  When the
+    named buckets overshoot the measurement (clock skew, an optimistic
+    collective model) they are scaled down proportionally so the report
+    never claims more than 100% of the wall.  Returns None when
+    ``measured_us`` is not positive."""
+    measured = float(measured_us)
+    if measured <= 0:
+        return None
+    named = [max(float(v), 0.0) for v in (device_compute_us, collective_us,
+                                          infeed_starved_us, ckpt_drain_us)]
+    total_named = sum(named)
+    if total_named > measured:
+        scale = measured / total_named
+        named = [v * scale for v in named]
+        total_named = measured
+    parts = named + [measured - total_named]
+    return {"%s_pct" % name: 100.0 * v / measured
+            for name, v in zip(ATTRIBUTION_BUCKETS, parts)}
 
 
 class TimeHistory(object):

@@ -68,6 +68,21 @@ def _register_feed(feed):
     _feeds.append(weakref.ref(feed))
 
 
+def _profile_handler(job_name):
+    """The ``on_profile`` capture handler for this node's HeartbeatSender:
+    JAX-hosting jobs run device-trace captures fanned out on beat replies
+    (:func:`profiling.handle_capture_request`); other roles get None — the
+    driver never targets them, and a ps node has no devices to trace."""
+    if job_name not in _JAX_JOBS:
+        return None
+    try:
+        from tensorflowonspark_tpu import profiling
+
+        return profiling.handle_capture_request
+    except Exception:  # pragma: no cover - stripped envs
+        return None
+
+
 def _node_metrics_provider(mgr, qname="input"):
     """Build the heartbeat metrics provider for this node's user-fn process.
 
@@ -106,6 +121,16 @@ def _node_metrics_provider(mgr, qname="input"):
                 parts.append(feed.counters_snapshot())
             except Exception:
                 pass
+        try:
+            # profiler-server liveness + per-device memory HWMs: device-plane
+            # health riding the same beat as the host-side feed counters
+            from tensorflowonspark_tpu import metrics as metrics_mod
+            from tensorflowonspark_tpu import profiler as profiler_mod
+
+            parts.append(profiler_mod.server_counters())
+            parts.append(metrics_mod.device_memory_counters())
+        except Exception:
+            pass
         try:
             feeder = mgr.get("feeder_metrics")
             if isinstance(feeder, dict):
@@ -624,7 +649,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 cluster_meta["server_addr"], executor_id,
                 heartbeat_interval,
                 metrics_provider=_node_metrics_provider(context.mgr),
-                trace_flow=node_meta.get("trace_flow")).start()
+                trace_flow=node_meta.get("trace_flow"),
+                on_profile=_profile_handler(context.job_name)).start()
             # Forked children inherit the parent's preemption registrations;
             # start from a clean slate, then install the SIGTERM drain in the
             # process that actually runs the user fn.
@@ -705,7 +731,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 cluster_meta["server_addr"], executor_id,
                 heartbeat_interval,
                 metrics_provider=_node_metrics_provider(mgr),
-                trace_flow=node_meta.get("trace_flow")).start()
+                trace_flow=node_meta.get("trace_flow"),
+                on_profile=_profile_handler(job_name)).start()
             _reset_preemption()
             _install_sigterm_drain()
             telemetry.install_sigusr1()

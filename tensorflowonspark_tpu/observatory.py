@@ -297,9 +297,20 @@ class ObservatoryServer(object):
     """
 
     def __init__(self, snapshot_fn, ring=None, status_fn=None,
-                 host="0.0.0.0", port=0, window_secs=60.0):
+                 host="0.0.0.0", port=0, window_secs=60.0,
+                 profile_fn=None, profiler_addresses_fn=None,
+                 capture_status_fn=None):
+        """``profile_fn(duration_ms=, steps=)`` backs ``GET /profile``
+        (typically ``CaptureCoordinator.trigger``; 503 when absent).
+        ``profiler_addresses_fn`` / ``capture_status_fn`` enrich ``/status``
+        with the per-host ``jax.profiler`` endpoints and the latest capture
+        state — lazy callables, because the observatory starts before the
+        roster exists."""
         self._snapshot_fn = snapshot_fn
         self._status_fn = status_fn
+        self._profile_fn = profile_fn
+        self._profiler_addresses_fn = profiler_addresses_fn
+        self._capture_status_fn = capture_status_fn
         self.ring = ring if ring is not None else SampleRing()
         self._window_secs = window_secs
         self._host = host
@@ -340,9 +351,56 @@ class ObservatoryServer(object):
             "series_depths": self.ring.depths(),
             "scrapes": self._scrapes,
         }
+        # Capture-target discovery without driver access: the per-host
+        # jax.profiler endpoints (empty until the roster completes) and the
+        # latest /profile capture's state.  Both lazy and guarded — the
+        # endpoint must answer during bring-up too.
+        if self._profiler_addresses_fn is not None:
+            try:
+                payload["profiler_addresses"] = (
+                    self._profiler_addresses_fn() or {})
+            except Exception:
+                payload["profiler_addresses"] = {}
+        if self._capture_status_fn is not None:
+            try:
+                payload["last_capture"] = self._capture_status_fn()
+            except Exception:
+                payload["last_capture"] = None
         # tf_status may hold arbitrary user values; never let one break
         # the endpoint
         return json.dumps(payload, default=str)
+
+    def _profile_response(self, query):
+        """Handle ``GET /profile``: parse the query, trigger a capture.
+        Returns (http_status, json_body)."""
+        if self._profile_fn is None:
+            return 503, json.dumps(
+                {"error": "profiling is not enabled on this cluster"})
+        import urllib.parse
+
+        params = urllib.parse.parse_qs(query or "")
+
+        def _int_param(name):
+            vals = params.get(name)
+            if not vals:
+                return None
+            return int(vals[0])
+
+        try:
+            duration_ms = _int_param("duration_ms")
+            steps = _int_param("steps")
+        except ValueError:
+            return 400, json.dumps(
+                {"error": "duration_ms and steps must be integers"})
+        try:
+            result = self._profile_fn(duration_ms=duration_ms, steps=steps)
+        except RuntimeError as e:
+            # no targets yet / capture in flight: caller's problem, not ours
+            return 409, json.dumps({"error": str(e)})
+        except Exception as e:
+            logger.exception("observatory: profile trigger failed")
+            return 500, json.dumps({"error": repr(e)})
+        return 200, json.dumps(result, default=str)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -352,20 +410,27 @@ class ObservatoryServer(object):
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                parts = self.path.split("?", 1)
+                path = parts[0]
+                query = parts[1] if len(parts) > 1 else ""
+                code = 200
                 if path == "/metrics":
                     body = observatory._metrics_text().encode("utf-8")
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path in ("/status", "/status/"):
                     body = observatory._status_json().encode("utf-8")
                     ctype = "application/json"
+                elif path in ("/profile", "/profile/"):
+                    code, text = observatory._profile_response(query)
+                    body = text.encode("utf-8")
+                    ctype = "application/json"
                 elif path == "/":
-                    body = b"tfos observatory: /metrics /status\n"
+                    body = b"tfos observatory: /metrics /status /profile\n"
                     ctype = "text/plain; charset=utf-8"
                 else:
                     self.send_error(404)
                     return
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

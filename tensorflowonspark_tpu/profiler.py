@@ -23,8 +23,14 @@ logger = logging.getLogger(__name__)
 def start_server(port=None):
     """Start this process's jax.profiler gRPC server; returns the port
     (0 when jax lacks profiler support).  Idempotent per process — jax
-    allows one server; subsequent calls return the first port."""
-    global _server_port
+    allows one server; subsequent calls return the first port.
+
+    A FAILED start does not latch: ``_server_port`` stays ``None`` so the
+    next call retries (a transient bind race / grpc hiccup at bring-up must
+    not permanently cost the node its capture capability), while
+    ``_server_state`` records the last outcome for the heartbeat counter
+    (:func:`server_counters`)."""
+    global _server_port, _server_state
     if _server_port is not None:
         return _server_port
     import jax
@@ -40,14 +46,25 @@ def start_server(port=None):
         jax.profiler.start_server(port)
     except Exception:
         logger.warning("jax profiler server unavailable", exc_info=True)
-        _server_port = 0
+        _server_state = "down"
         return 0
     _server_port = port
+    _server_state = "up"
     logger.info("jax profiler server listening on port %d", port)
     return port
 
 
+def server_counters():
+    """Heartbeat-counter view of the profiler server: ``{}`` when a start
+    was never attempted, else ``profiler_server_up_max`` 1/0 (``_max``
+    suffix -> rendered as a Prometheus gauge by the observatory)."""
+    if _server_state is None:
+        return {}
+    return {"profiler_server_up_max": 1 if _server_state == "up" else 0}
+
+
 _server_port = None
+_server_state = None  # None = never attempted, else "up"/"down" (last try)
 
 
 def parse_profile_steps(spec):

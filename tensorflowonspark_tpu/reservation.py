@@ -271,6 +271,12 @@ class Server(MessageSocket):
         # observatory can derive rates.  Attached by cluster.run when the
         # observatory is enabled; None costs one attribute load per latch.
         self.sample_ring = None
+        # Optional profile-capture coordinator (profiling.CaptureCoordinator
+        # duck type): pending capture requests ride OUT on HBEAT replies
+        # (``poll(executor_id)``) and per-node artifacts ride BACK on PROF
+        # messages (``receive(data)``).  Attached by cluster.run when the
+        # observatory is enabled; None keeps the HBEAT path byte-identical.
+        self.profile_coordinator = None
         # Executors whose HBEAT-carried trace flow was already stitched into
         # the driver trace (one flow step per node, not one per beat).
         self._hbeat_flow_seen = set()
@@ -537,7 +543,19 @@ class Server(MessageSocket):
                     telemetry.get_tracer().flow_end(
                         "reservation/register_flow", flow, leg="first_hbeat",
                         executor_id=executor_id)
-                self.send(sock, {"type": "OK"})
+                reply = {"type": "OK"}
+                # Capture fan-out: a pending profile request for this
+                # executor rides the beat reply (poll marks it delivered,
+                # so each node sees each capture exactly once).
+                if self.profile_coordinator is not None:
+                    try:
+                        req = self.profile_coordinator.poll(executor_id)
+                    except Exception:
+                        logger.exception("profile coordinator poll failed")
+                        req = None
+                    if req:
+                        reply["profile"] = req
+                self.send(sock, reply)
             else:
                 self.send(sock, {"type": "ERR",
                                  "error": "marked dead by the liveness "
@@ -552,6 +570,20 @@ class Server(MessageSocket):
                     "reservation/bye", executor_id=executor_id,
                     reason=data.get("reason"))
             self.send(sock, {"type": "OK"})
+        elif mtype == "PROF":
+            # A node returning (or failing) a profile capture it was handed
+            # on a HBEAT reply; the payload carries base64 artifact files.
+            data = msg.get("data") or {}
+            if self.profile_coordinator is None:
+                self.send(sock, {"type": "ERR",
+                                 "error": "no capture coordinator"})
+            else:
+                try:
+                    self.profile_coordinator.receive(data)
+                    self.send(sock, {"type": "OK"})
+                except Exception as e:
+                    logger.exception("profile artifact ingest failed")
+                    self.send(sock, {"type": "ERR", "error": str(e)})
         elif mtype == "QUERY":
             self.send(sock, {"type": "QUERY", "done": self.reservations.done()})
         elif mtype == "QINFO":
@@ -722,10 +754,13 @@ class Client(MessageSocket):
                 resp.get("error", resp)))
 
     def heartbeat(self, executor_id, metrics=None, trace_flow=None):
-        """Send one liveness beat; returns False if the server fenced this
-        node (declared dead — the caller should stop beating and may choose
-        to self-terminate rather than run as a zombie).  ``metrics`` is an
-        optional flat JSON dict of telemetry counters piggybacked on the
+        """Send one liveness beat; returns the (truthy) server reply dict on
+        acceptance, or ``False`` if the server fenced this node (declared
+        dead — the caller should stop beating and may choose to
+        self-terminate rather than run as a zombie).  The reply may carry a
+        ``"profile"`` key: a capture request fanned out by the driver's
+        profile coordinator (see :class:`HeartbeatSender`).  ``metrics`` is
+        an optional flat JSON dict of telemetry counters piggybacked on the
         beat (messages are JSON-only; see module docstring); ``trace_flow``
         is an optional flow id carrying the node's registration trace
         context (the server stitches it on the first beat)."""
@@ -735,7 +770,17 @@ class Client(MessageSocket):
         if trace_flow:
             data["trace_flow"] = trace_flow
         resp = self._request({"type": "HBEAT", "data": data})
-        return resp.get("type") == "OK"
+        return resp if resp.get("type") == "OK" else False
+
+    def profile_result(self, data, timeout=120.0):
+        """Upload one capture's artifacts (``PROF``): ``data`` is the
+        profiling-module payload (executor_id, capture_id, base64 files or
+        an error).  A long explicit timeout — device traces are megabytes
+        and must not be clipped by the beat-sized default."""
+        resp = self._request({"type": "PROF", "data": data}, timeout=timeout)
+        if resp.get("type") != "OK":
+            raise Exception("profile upload rejected: {}".format(
+                resp.get("error", resp)))
 
     def goodbye(self, executor_id, reason=None, metrics=None):
         """Clean liveness deregistration: this node is finishing on purpose,
@@ -826,22 +871,31 @@ class HeartbeatSender(object):
     """
 
     def __init__(self, server_addr, executor_id, interval,
-                 metrics_provider=None, trace_flow=None):
+                 metrics_provider=None, trace_flow=None, on_profile=None):
         """``metrics_provider``: optional zero-arg callable returning a flat
         JSON-serializable counter dict to piggyback on each beat (errors are
         swallowed — metrics must never cost a liveness beat).
         ``trace_flow``: optional flow id (the node's registration trace
         context) piggybacked on beats; the server stitches the first one
-        into the driver trace."""
+        into the driver trace.
+        ``on_profile``: optional ``fn(request) -> result_data`` handling a
+        capture request fanned out on a beat reply (see
+        ``profiling.handle_capture_request``).  It runs on a separate
+        daemon thread — a capture takes seconds, and blocking the beat loop
+        that long would fence the node — and its result is uploaded via
+        :meth:`Client.profile_result` on a dedicated connection (the beat
+        client is not thread-safe).  Requests are deduped by capture id."""
         self.server_addr = tuple(server_addr)
         self.executor_id = executor_id
         self.interval = interval
         self.metrics_provider = metrics_provider
         self.trace_flow = trace_flow
+        self.on_profile = on_profile
         self.fenced = False
         self._stop = threading.Event()
         self._client = None
         self._beats_sent = 0
+        self._profiles_seen = set()  # capture ids already handed off
         self._thread = threading.Thread(
             target=self._run, name="heartbeat-sender", daemon=True)
 
@@ -878,18 +932,55 @@ class HeartbeatSender(object):
                 except Exception as e:
                     logger.debug("heartbeat metrics provider failed: %s", e)
             try:
-                if not self._ensure_client().heartbeat(
-                        self.executor_id, metrics=metrics,
-                        trace_flow=self.trace_flow):
+                resp = self._ensure_client().heartbeat(
+                    self.executor_id, metrics=metrics,
+                    trace_flow=self.trace_flow)
+                if not resp:
                     logger.error(
                         "executor %s fenced by the liveness monitor; "
                         "stopping heartbeats", self.executor_id)
                     self.fenced = True
                     return
+                if isinstance(resp, dict) and resp.get("profile"):
+                    self._maybe_capture(resp["profile"])
             except Exception as e:
                 logger.warning("heartbeat failed (%s); will retry with a "
                                "fresh connection", e)
                 self._drop_client()
+
+    def _maybe_capture(self, request):
+        """Hand a beat-reply capture request to ``on_profile`` on its own
+        daemon thread (once per capture id); the result goes back as a PROF
+        message over a fresh connection."""
+        capture_id = (request or {}).get("capture_id")
+        if (self.on_profile is None or not capture_id
+                or capture_id in self._profiles_seen):
+            return
+        self._profiles_seen.add(capture_id)
+
+        def _capture():
+            try:
+                result = self.on_profile(request)
+            except Exception as e:
+                logger.exception("profile capture failed")
+                result = {"capture_id": capture_id, "error": repr(e)}
+            if not isinstance(result, dict):
+                result = {"capture_id": capture_id,
+                          "error": "capture handler returned %r" % (result,)}
+            result.setdefault("capture_id", capture_id)
+            result["executor_id"] = self.executor_id
+            client = None
+            try:
+                client = Client(self.server_addr, retries=1)
+                client.profile_result(result)
+            except Exception as e:
+                logger.warning("profile upload failed: %s", e)
+            finally:
+                if client is not None:
+                    client.close()
+
+        threading.Thread(target=_capture, name="profile-capture",
+                         daemon=True).start()
 
     def stop(self, goodbye=True, reason=None):
         """Stop beating; with ``goodbye`` also deregister from the monitor.

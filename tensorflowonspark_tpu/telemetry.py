@@ -71,6 +71,16 @@ DEFAULT_CAPACITY = 16384
 _MAX_SUFFIXES = ("_hwm", "_max")
 
 
+def wall_time_us():
+    """Now, in the plane's timestamp convention: wall-clock microseconds
+    (``time.time() * 1e6``).  Every trace event this module emits uses it,
+    which is what lets per-process files — and the device traces
+    ``scripts/analyze_profile.py`` merges in — line up on one Perfetto
+    timeline.  Use this, not a monotonic clock, for any event that must
+    co-plot with the traces."""
+    return time.time() * 1e6
+
+
 def merge_counters(snapshots):
     """Merge an iterable of flat counter dicts into one aggregate.
 

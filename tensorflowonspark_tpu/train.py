@@ -313,6 +313,14 @@ class Trainer(object):
         self._flops_per_sec = None   # latest achieved per-device FLOP/s
         self._acct_history = None    # TimeHistory the accountant follows
         self._windows_seen = 0       # timestamp_log entries consumed
+        # Roofline/attribution inputs captured at compile time
+        # (_ensure_history): cost-analysis bytes accessed, lower+compile
+        # wall seconds, and the metrics.roofline() classification for the
+        # canonical step program.  None until the first compile / when the
+        # backend has no cost model.
+        self._step_bytes = None
+        self._compile_secs = None
+        self._roofline = None
 
     def counters_snapshot(self):
         """Flat overlap + goodput counters for heartbeat payloads /
@@ -332,7 +340,20 @@ class Trainer(object):
         :data:`~tensorflowonspark_tpu.metrics.STEP_MS_BUCKETS`;
         ``train_mfu_pct_max`` / ``train_flops_per_sec_max`` are the latest
         window's gauges (``_max`` suffix -> merged by max, rendered as
-        Prometheus gauges)."""
+        Prometheus gauges).
+
+        Attribution report (once the first window closes):
+        ``attrib_<bucket>_pct_max`` for the buckets in
+        :data:`~tensorflowonspark_tpu.metrics.ATTRIBUTION_BUCKETS`,
+        decomposing the device-synced step-loop wall time accumulated so
+        far (``step_ms_sum_us``) into roofline-ideal device compute,
+        collective, infeed starvation, checkpoint drain, and the
+        unattributed remainder — always summing to 100 (see
+        :func:`~tensorflowonspark_tpu.metrics.attribute_step_time`).
+        The observatory renders them as ``tfos_attrib_*`` gauges.  Plus
+        ``train_compile_us_max`` (lower+compile wall time of the canonical
+        step) and ``train_step_bytes_max`` (cost-analysis bytes accessed
+        per step) when known."""
         snap = {
             "dispatch_count": self._dispatch_count,
             "dispatch_gap_us": self._dispatch_gap_us,
@@ -356,7 +377,44 @@ class Trainer(object):
             snap["train_mfu_pct_max"] = round(self._mfu_pct, 4)
         if self._flops_per_sec is not None:
             snap["train_flops_per_sec_max"] = self._flops_per_sec
+        if self._compile_secs is not None:
+            snap["train_compile_us_max"] = int(self._compile_secs * 1e6)
+        if self._step_bytes:
+            snap["train_step_bytes_max"] = self._step_bytes
+        attrib = self.attribution_report()
+        if attrib:
+            for name, pct in attrib.items():
+                snap["attrib_%s_max" % name] = round(pct, 4)
         return snap
+
+    def attribution_report(self):
+        """Decompose the closed-window step-loop wall time into the
+        :data:`~tensorflowonspark_tpu.metrics.ATTRIBUTION_BUCKETS`
+        percentage buckets (summing to exactly 100), or None before the
+        first window closes.
+
+        ``device_compute`` is the roofline-ideal time — closed-window steps
+        times the per-step floor the device cannot beat at the roofline
+        ceiling (:func:`~tensorflowonspark_tpu.metrics.roofline`); 0 when
+        the backend has no cost model.  ``collective`` is 0 today (no
+        per-collective timing source on a single-controller mesh — XLA
+        overlaps them with compute; the bucket exists so the report shape
+        is stable when a timing source lands).  ``infeed_starved`` /
+        ``ckpt_drain`` come from the cumulative goodput tallies (whole-run
+        figures, marginally wider than the closed-window wall — the
+        proportional-downscale rule in ``attribute_step_time`` keeps the
+        report honest).  ``unattributed`` is the remainder: device
+        inefficiency below the roofline ceiling plus host overhead the
+        other buckets cannot see — the bucket MFU work burns down."""
+        measured_us = self._step_ms_sum_us
+        if not measured_us:
+            return None
+        ideal = (self._roofline or {}).get("ideal_step_seconds")
+        device_us = self._step_ms_count * ideal * 1e6 if ideal else 0.0
+        return metrics_mod.attribute_step_time(
+            measured_us, device_us,
+            infeed_starved_us=self._goodput_infeed_starved_us,
+            ckpt_drain_us=self._goodput_ckpt_drain_us)
 
     def _account_windows(self):
         """Fold newly-closed TimeHistory windows into the step-time
@@ -464,9 +522,12 @@ class Trainer(object):
             if self.step_flops_override is not None:
                 flops = self.step_flops_override
             else:
-                flops = metrics_mod.estimate_step_flops(
+                cost = metrics_mod.estimate_step_cost(
                     jax.jit(self._plain_core), self.state,
                     example_batch, example_mask)
+                flops = cost["flops"]
+                self._step_bytes = cost["bytes_accessed"]
+                self._compile_secs = cost["compile_secs"]
                 # only supplement a SUCCESSFUL base estimate: when cost
                 # analysis is unavailable (returns None) the supplement
                 # alone would publish a confidently tiny MFU with the
@@ -474,6 +535,8 @@ class Trainer(object):
                 # unknown) is the right answer there
                 if self.extra_step_flops and flops:
                     flops = flops + self.extra_step_flops
+                self._roofline = metrics_mod.roofline(flops,
+                                                      self._step_bytes)
             self.history = metrics_mod.TimeHistory(
                 batch_size=self.batch_size or 0, log_steps=self.log_steps,
                 step_flops=flops, summary_writer=self.summary_writer)
@@ -630,6 +693,14 @@ class Trainer(object):
             from tensorflowonspark_tpu import node as node_mod
 
             node_mod._register_feed(self)
+        except Exception:  # pragma: no cover - stripped envs
+            pass
+        # Step-counted profile captures (GET /profile?steps=N) watch this
+        # trainer's dispatch counter to know when N steps have passed.
+        try:
+            from tensorflowonspark_tpu import profiling as profiling_mod
+
+            profiling_mod.register_step_counter(lambda: self._dispatch_count)
         except Exception:  # pragma: no cover - stripped envs
             pass
         last_loss = None

@@ -44,6 +44,31 @@ def test_step_profiler_captures_range(tmp_path):
 def test_profiler_server_start_idempotent():
     port = profiler.start_server()
     assert profiler.start_server() == port  # same port on second call
+    assert profiler.server_counters() == {"profiler_server_up_max": 1}
+
+
+def test_profiler_server_failure_does_not_latch(monkeypatch):
+    """A failed start must leave the next call free to retry (transient
+    bind races at bring-up must not permanently cost capture capability),
+    while the heartbeat counter records the last outcome."""
+    import jax
+
+    monkeypatch.setattr(profiler, "_server_port", None)
+    monkeypatch.setattr(profiler, "_server_state", None)
+    assert profiler.server_counters() == {}  # never attempted -> no counter
+
+    def boom(port):
+        raise RuntimeError("grpc hiccup")
+
+    monkeypatch.setattr(jax.profiler, "start_server", boom)
+    assert profiler.start_server() == 0
+    assert profiler._server_port is None  # not latched
+    assert profiler.server_counters() == {"profiler_server_up_max": 0}
+
+    monkeypatch.setattr(jax.profiler, "start_server", lambda port: None)
+    port = profiler.start_server()
+    assert port > 0  # the retry succeeded
+    assert profiler.server_counters() == {"profiler_server_up_max": 1}
 
 
 def test_cluster_publishes_profiler_ports():

@@ -39,7 +39,11 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # then prove the device plane explains itself: attribution gauges on
 # /metrics summing to ~100%, a mid-run GET /profile collecting every
 # node's device trace to the driver, and analyze_profile.py merging them
-# with the host traces into one Perfetto timeline
+# with the host traces into one Perfetto timeline, and finally prove the
+# watchtower catches an injected straggler and an injected NaN loss live
+# (correctly attributed on /alerts, /metrics, /status and as trace
+# instants) and that metrics_replay.py re-derives the same alerts from
+# the on-disk journal after the cluster is gone
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -47,5 +51,6 @@ python scripts/ci_assert_dataservice.py
 python scripts/ci_assert_overlap.py
 python scripts/ci_assert_observatory.py
 python scripts/ci_assert_profiling.py
+python scripts/ci_assert_watchtower.py
 
 exit $rc

@@ -1,0 +1,177 @@
+"""Replay a watchtower metrics journal offline: re-derive alerts, render a
+per-node timeline.
+
+The live watchtower journals periodic ``metrics_snapshot()`` records and
+every alert it fired into an append-only JSONL under
+``<log_dir>/watchtower/journal.jsonl``.  This tool re-runs the SAME rule
+engine (:func:`tensorflowonspark_tpu.watchtower.replay_journal`) over that
+file after the cluster is gone, so post-mortems answer "when did node 3
+start straggling, and would today's thresholds have caught it" without a
+live scrape window — and threshold changes can be evaluated against
+recorded history (``--config``) before they ship.
+
+Usage:
+  python scripts/metrics_replay.py <journal.jsonl>            # human report
+  python scripts/metrics_replay.py <journal.jsonl> --json     # machine doc
+  python scripts/metrics_replay.py j.jsonl --config '{"straggler_z": 3}'
+  python scripts/metrics_replay.py j.jsonl --keys dispatch_count,infeed_batches
+
+Exit status: 0 on a clean replay, 2 when the journal has no snapshot
+records (nothing to evaluate).
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tensorflowonspark_tpu import watchtower  # noqa: E402
+
+#: default per-node timeline columns: cumulative counters shown as windowed
+#: deltas between consecutive snapshots, gauges shown as the latest reading
+DEFAULT_KEYS = ("step_ms_count", "train_mfu_pct_max", "train_loss_max",
+                "train_nonfinite_loss", "dispatch_count")
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if not math.isfinite(v):
+            return repr(v)
+        return "%.4g" % v
+    return str(v)
+
+
+def build_timeline(records, result, keys):
+    """One row per (snapshot time, node): selected counters plus the
+    average step time derived from the ``step_ms_*`` histogram deltas and
+    the rules that fired at that timestamp."""
+    snaps = sorted((r for r in records if r.get("kind") == "snapshot"),
+                   key=lambda r: r.get("time", 0))
+    if not snaps:
+        return []
+    t0 = snaps[0].get("time", 0.0)
+    alerts_by_time = {}
+    for a in result["alerts"]:
+        alerts_by_time.setdefault(round(a.get("time", 0.0), 3), []).append(a)
+    prev = {}
+    rows = []
+    for rec in snaps:
+        now = rec.get("time", 0.0)
+        fired = alerts_by_time.get(round(now, 3), [])
+        for node in sorted((rec.get("snapshot") or {}).get("nodes") or {}):
+            c = rec["snapshot"]["nodes"][node]
+            if not isinstance(c, dict):
+                continue
+            row = {"t": now - t0, "node": node}
+            # avg ms/step over the delta from this node's previous snapshot
+            p = prev.get(node, {})
+            dn = c.get("step_ms_count", 0) - p.get("step_ms_count", 0)
+            dus = c.get("step_ms_sum_us", 0) - p.get("step_ms_sum_us", 0)
+            row["step_ms"] = dus / dn / 1000.0 if dn > 0 else None
+            for key in keys:
+                row[key] = c.get(key)
+            row["alerts"] = ",".join(
+                a.get("rule", "?") for a in fired
+                if str(a.get("executor")) == node) or ""
+            rows.append(row)
+            prev[node] = c
+    return rows
+
+
+def render_table(rows, keys):
+    cols = ["t", "node", "step_ms"] + list(keys) + ["alerts"]
+    header = {"t": "t+secs", "step_ms": "ms/step"}
+    table = [[header.get(c, c) for c in cols]]
+    for row in rows:
+        table.append(["%.1f" % row["t"] if c == "t" else _fmt(row.get(c))
+                      for c in cols])
+    widths = [max(len(r[i]) for r in table) for i in range(len(cols))]
+    lines = []
+    for i, r in enumerate(table):
+        lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Re-run the watchtower rule engine over a metrics "
+                    "journal and render a per-node timeline.")
+    ap.add_argument("journal", help="path to watchtower journal.jsonl")
+    ap.add_argument("--config", default=None,
+                    help="JSON dict of rule-config overrides "
+                         "(see watchtower.DEFAULT_CONFIG)")
+    ap.add_argument("--keys", default=",".join(DEFAULT_KEYS),
+                    help="comma-separated counter keys for the timeline "
+                         "columns (default: %(default)s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one machine-readable JSON document instead "
+                         "of the human report")
+    ap.add_argument("--limit", type=int, default=None,
+                    help="show only the last N timeline rows")
+    args = ap.parse_args(argv)
+
+    overrides = json.loads(args.config) if args.config else None
+    keys = tuple(k for k in args.keys.split(",") if k)
+
+    records = watchtower.read_journal(args.journal)
+    result = watchtower.replay_journal(records, config=overrides)
+    rows = build_timeline(records, result, keys)
+    if args.limit:
+        rows = rows[-args.limit:]
+
+    if args.json:
+        json.dump({"journal": args.journal,
+                   "snapshots": result["snapshots"],
+                   "config": result["config"],
+                   "journaled_alerts": result["journaled_alerts"],
+                   "replayed_alerts": result["alerts"],
+                   "timeline": rows}, sys.stdout, default=str)
+        print()
+        return 0 if result["snapshots"] else 2
+
+    print("journal: %s" % args.journal)
+    print("snapshot records: %d, journaled alerts: %d, replayed alerts: %d"
+          % (result["snapshots"], len(result["journaled_alerts"]),
+             len(result["alerts"])))
+    if not result["snapshots"]:
+        print("no snapshot records: nothing to evaluate", file=sys.stderr)
+        return 2
+    t0 = min((r.get("time", 0.0) for r in records
+              if r.get("kind") == "snapshot"), default=0.0)
+    if result["alerts"]:
+        print("\nreplayed alerts (rule engine re-run over the journal):")
+        for a in result["alerts"]:
+            print("  [t+%7.1fs] %-24s executor=%-6s %s"
+                  % (a.get("time", 0.0) - t0, a.get("rule"),
+                     a.get("executor"), a.get("message", "")))
+    else:
+        print("\nno alerts re-derived at these thresholds")
+    live = {(a.get("rule"), str(a.get("executor")))
+            for a in result["journaled_alerts"]}
+    replayed = {(a.get("rule"), str(a.get("executor")))
+                for a in result["alerts"]}
+    only_live = sorted(live - replayed)
+    only_replay = sorted(replayed - live)
+    if only_live:
+        print("journaled live but not re-derived (threshold overrides or "
+              "sub-snapshot transients): %s" % only_live)
+    if only_replay:
+        print("re-derived but not journaled live: %s" % only_replay)
+    print("\nper-node timeline:")
+    print(render_table(rows, keys))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # |head closed our stdout mid-report
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

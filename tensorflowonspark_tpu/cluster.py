@@ -44,7 +44,7 @@ class TPUCluster(object):
 
     def __init__(self, backend, cluster_meta, cluster_info, input_mode,
                  server, start_job, tf_status, queues, observatory=None,
-                 profiling=None):
+                 profiling=None, watchtower=None):
         self.backend = backend
         self.cluster_meta = cluster_meta
         self.cluster_info = cluster_info
@@ -61,6 +61,11 @@ class TPUCluster(object):
         # flag): .trigger() captures device traces from the driver without
         # going through HTTP; artifacts land under <log_dir>/profiles
         self.profiling = profiling
+        # optional watchtower.Watchtower (rides the observatory flag):
+        # streaming straggler/anomaly detection over the sample ring;
+        # stopped before the observatory so the final journal flush and
+        # alert-count latch land in tf_status (see _latch_telemetry)
+        self.watchtower = watchtower
 
     # -- data plane -------------------------------------------------------
 
@@ -272,6 +277,19 @@ class TPUCluster(object):
                 self.tf_status.setdefault("telemetry", snap)
         except Exception:
             logger.debug("telemetry latch failed", exc_info=True)
+        if self.watchtower is not None:
+            # stop the rule engine first: its final tick + journal flush
+            # must see the closing metrics, and the alert tallies belong in
+            # tf_status next to the telemetry latch
+            try:
+                self.watchtower.stop()
+                counts = self.watchtower.alert_counts()
+                if counts:
+                    self.tf_status.setdefault("alerts", counts)
+            except Exception:
+                logger.debug("watchtower stop failed", exc_info=True)
+            telemetry_mod.unregister_flight_source("sample_ring_tail")
+            telemetry_mod.unregister_flight_source("alerts")
         if self.observatory is not None:
             # exporter outlives the nodes (scrapes tolerate node death) but
             # not the cluster handle; stop is idempotent across the several
@@ -512,7 +530,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         release_port=True, profiler=False, executor_env=None,
         driver_ps_nodes=False, heartbeat_interval=5.0, heartbeat_misses=3,
         telemetry=False, telemetry_dir=None, data_service=None,
-        observatory=False, observatory_port=0):
+        observatory=False, observatory_port=0, watchtower=None):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -567,6 +585,17 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         beats and the exporter mostly shows ``tfos_nodes``; enable both
         for the full metric vocabulary.  See docs/OBSERVABILITY.md.
       observatory_port: TCP port for the observatory (0 = ephemeral).
+      watchtower: streaming straggler/anomaly detection over the
+        observatory's sample ring (see
+        :mod:`~tensorflowonspark_tpu.watchtower`): ``None`` (default)
+        enables it whenever the observatory is on, ``False`` disables it,
+        a dict overrides rule thresholds key-wise (see
+        ``watchtower.DEFAULT_CONFIG``).  Alerts surface on ``GET
+        /alerts``, as ``tfos_alerts_total`` on ``/metrics``, as
+        ``watchtower/alert`` trace instants, and in the append-only JSONL
+        journal at ``<log_dir>/watchtower/journal.jsonl`` (replayable
+        offline via ``scripts/metrics_replay.py``).  Suspect-node
+        verdicts land in ``tf_status["suspects"]``.
     """
     if hasattr(cluster_backend, "parallelize"):  # raw SparkContext
         cluster_backend = backend_mod.SparkBackend(cluster_backend)
@@ -700,6 +729,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
 
     obs = None
     profiling_coord = None
+    wt = None
     if observatory:
         from tensorflowonspark_tpu import observatory as observatory_mod
         from tensorflowonspark_tpu import profiling as profiling_mod
@@ -725,15 +755,39 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                     for m in server.reservations.get()
                     if isinstance(m, dict) and m.get("profiler_port")]
 
+        if watchtower is not False:
+            from tensorflowonspark_tpu import watchtower as watchtower_mod
+
+            def _on_suspect(executor, alert):
+                # the elastic-recovery plane's consumption point: verdicts
+                # accumulate here next to dead_nodes/replacements
+                tf_status.setdefault("suspects", {})[str(executor)] = (
+                    alert.get("rule"))
+
+            wt = watchtower_mod.Watchtower(
+                ring=ring, snapshot_fn=server.metrics_snapshot,
+                heartbeat_interval=heartbeat_interval,
+                config=watchtower if isinstance(watchtower, dict) else None,
+                journal_path=os.path.abspath(os.path.join(
+                    log_dir or ".", "watchtower", "journal.jsonl")),
+                on_suspect=_on_suspect, beat_ages_fn=server.beat_ages)
+            wt.start()
+            # Flight records (SIGUSR1 / stall dumps) now carry the metric
+            # trajectory and alert log leading into the stall.
+            telemetry_mod.register_flight_source("sample_ring_tail",
+                                                 wt.ring_tail)
+            telemetry_mod.register_flight_source("alerts", wt.alerts)
+
         obs = observatory_mod.ObservatoryServer(
             server.metrics_snapshot, ring=ring,
             status_fn=lambda: tf_status, port=observatory_port,
             profile_fn=profiling_coord.trigger,
             profiler_addresses_fn=_profiler_addresses,
-            capture_status_fn=profiling_coord.status)
+            capture_status_fn=profiling_coord.status,
+            watchtower=wt)
         addr = obs.start()
-        logger.info("observatory serving /metrics, /status and /profile at "
-                    "http://%s:%d", addr[0], addr[1])
+        logger.info("observatory serving /metrics, /status, /profile and "
+                    "/alerts at http://%s:%d", addr[0], addr[1])
 
     # Normalize the data-service spec to {"dispatcher": [host, port]} for
     # the JSON hop to executors (ctx.get_service_feed consumes it).
@@ -836,4 +890,5 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
 
     return TPUCluster(cluster_backend, cluster_meta, cluster_info, input_mode,
                       server, start_job, tf_status, tuple(queues),
-                      observatory=obs, profiling=profiling_coord)
+                      observatory=obs, profiling=profiling_coord,
+                      watchtower=wt)

@@ -1644,6 +1644,17 @@ class ServiceFeed(object):
                 "dataservice_split_dupes": self.split_dupes,
                 "dataservice_splits_discarded": self.splits_discarded,
                 "dataservice_bytes": self.bytes_received}
+        try:
+            # Instantaneous prefetch-queue fill percentage, sampled per
+            # beat: pinned at 100 the producer outruns the consumer (the
+            # watchtower's saturation rule); pinned at 0 with stalls the
+            # feed workers are the bottleneck.
+            cap = self._chunks.maxsize
+            if cap:
+                snap["dataservice_queue_sat_pct_max"] = round(
+                    100.0 * self._chunks.qsize() / cap, 2)
+        except Exception:
+            pass
         for fmt, n in list(self.wire_formats.items()):
             snap["wire_{}".format(fmt)] = n
         return snap

@@ -197,6 +197,12 @@ class _NullInjector(object):
     def on_split(self, n=1):
         pass
 
+    def on_step(self, step=None):
+        pass
+
+    def corrupt_batch(self, batch, step=None):
+        return batch
+
     def should_drop_heartbeat(self, beats_sent):
         return False
 
@@ -246,6 +252,13 @@ class FaultInjector(object):
     - ``kill_after_splits``: SIGKILL a data-service feed worker once it has
       finished streaming N splits — the mid-job worker death whose splits
       the dispatcher must re-pool (exactly-once visitation under failure).
+    - ``sleep_per_step_secs``: sleep this long in the training loop before
+      EVERY dispatch (:meth:`on_step`) — turns this node into a straggler
+      the watchtower's cross-node rules must name without killing anything.
+    - ``nan_batch_at_step``: once the host step counter reaches N, replace
+      every floating leaf of ONE batch with NaN (:meth:`corrupt_batch`,
+      fires once) — the NaN'd loss then arises through real training math,
+      exercising the window-boundary nonfinite tallies end to end.
     - ``drop_heartbeats_after``: heartbeat sender emits N beats, then goes
       silent while the process lives (tests missed-beat detection without a
       real death).
@@ -271,6 +284,7 @@ class FaultInjector(object):
         self._tasks = 0
         self._chunks = 0
         self._splits = 0
+        self._slow_fired = False
 
     @staticmethod
     def _fired(kind, flush=False, **attrs):
@@ -357,6 +371,42 @@ class FaultInjector(object):
                            "%d splits", os.getpid(), self._splits)
             self._fired("kill_after_splits", flush=True, splits=self._splits)
             self._kill_self()
+
+    def on_step(self, step=None):
+        """Training-loop hook (``fit_feed``, once per dispatch): sleep
+        ``sleep_per_step_secs`` before the dispatch, making this node a
+        persistent straggler rather than a dead one."""
+        delay = self.spec.get("sleep_per_step_secs")
+        if not delay:
+            return
+        if not self._slow_fired:
+            self._slow_fired = True
+            logger.warning("FaultInjector: slowing pid %d by %.3fs/step",
+                           os.getpid(), delay)
+            self._fired("sleep_per_step", delay_secs=delay, step=step)
+        time.sleep(delay)
+
+    def corrupt_batch(self, batch, step=None):
+        """Training-loop hook: once the host step counter reaches
+        ``nan_batch_at_step``, replace every floating leaf of one batch
+        with NaN (fires once).  The nonfinite loss/grads then arise through
+        the real jitted step, not a mocked value."""
+        at = self.spec.get("nan_batch_at_step")
+        if at is None or (step is not None and step < at):
+            return batch
+        self.spec.pop("nan_batch_at_step")  # fire once
+        logger.warning("FaultInjector: NaN-corrupting batch at step %s", step)
+        self._fired("nan_batch", step=step)
+        import jax
+        import jax.numpy as jnp
+
+        def nanify(x):
+            if (hasattr(x, "dtype")
+                    and jnp.issubdtype(x.dtype, jnp.floating)):
+                return jnp.full(x.shape, jnp.nan, x.dtype)
+            return x
+
+        return jax.tree_util.tree_map(nanify, batch)
 
     def should_drop_heartbeat(self, beats_sent):
         """Heartbeat-sender hook: True once ``drop_heartbeats_after`` beats

@@ -292,6 +292,11 @@ class TimeHistory(object):
         # boundaries, where a sync happens anyway
         self._pending_losses = []
         self._loss_curve_end = 0  # last step the per-step curve has covered
+        # Host copy of the value the last window boundary synced on (scalar
+        # loss, or a per-step loss vector under K-steps-per-dispatch) — the
+        # Trainer's training-health counters read it here, so observing the
+        # loss costs no sync beyond the one the boundary already forced.
+        self.last_synced_value = None
 
     def on_train_begin(self):
         self.train_start_time = time.time()
@@ -301,13 +306,15 @@ class TimeHistory(object):
     @staticmethod
     def _sync(value):
         """Force a device->host readback so the host clock reflects device
-        completion.  A readback (not just ``block_until_ready``): on
+        completion; returns the host value (None when there was nothing to
+        sync on).  A readback (not just ``block_until_ready``): on
         remotely-attached backends the transfer is the only barrier that
         provably spans the full dispatch chain."""
-        if value is not None:
-            import jax
+        if value is None:
+            return None
+        import jax
 
-            jax.device_get(jax.block_until_ready(value))
+        return jax.device_get(jax.block_until_ready(value))
 
     def on_step_end(self, value=None):
         self.on_steps_end(1, value)
@@ -332,7 +339,9 @@ class TimeHistory(object):
         if vec is not None and self.summary_writer is not None:
             self._pending_losses.append((before, vec))
         if self.global_steps // self.log_steps > before // self.log_steps:
-            self._sync(value)
+            synced = self._sync(value)
+            if synced is not None:
+                self.last_synced_value = synced
             now = time.time()
             window_steps = self.global_steps - self.timestamp_log[-1][0]
             elapsed = now - self.start_time
@@ -381,7 +390,9 @@ class TimeHistory(object):
         return drained
 
     def on_train_end(self, value=None):
-        self._sync(value)
+        synced = self._sync(value)
+        if synced is not None:
+            self.last_synced_value = synced
         self.elapsed = time.time() - self.train_start_time
         if self.summary_writer is not None and self._pending_losses:
             # flush the tail of the per-step loss curve (steps since the

@@ -141,7 +141,10 @@ def _node_metrics_provider(mgr, qname="input"):
             depth = mgr.get_queue(qname).qsize()
             if depth > hwm["queue_depth_hwm"]:
                 hwm["queue_depth_hwm"] = depth
-            parts.append(dict(hwm))
+            # Instantaneous depth next to the high-water mark: the HWM can
+            # never come back down, so a live backlog signal (is the queue
+            # draining NOW?) needs its own gauge.
+            parts.append(dict(hwm, queue_depth_max=depth))
         except Exception:
             pass
         return telemetry.merge_counters(parts)

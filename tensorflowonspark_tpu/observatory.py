@@ -19,12 +19,13 @@ dependency:
   gauges, everything else to counters), and the Trainer's
   ``step_ms_le_<bound>`` counters folded into one proper histogram.
 - :class:`ObservatoryServer` — a stdlib ``ThreadingHTTPServer`` serving
-  ``GET /metrics`` (Prometheus text) and ``GET /status`` (JSON:
-  ``tf_status`` + ``metrics_snapshot`` + ring depths), started by
-  ``cluster.run(..., observatory=True)`` next to the rendezvous and
-  stopped with it.  Every render works from ONE snapshot copy taken at
-  scrape start, so a node dying mid-scrape can never produce a
-  half-mutated exposition.
+  ``GET /metrics`` (Prometheus text), ``GET /status`` (JSON:
+  ``tf_status`` + ``metrics_snapshot`` + ring depths), and — when a
+  watchtower is attached — ``GET /alerts`` (the bounded alert log),
+  started by ``cluster.run(..., observatory=True)`` next to the
+  rendezvous and stopped with it.  Every render works from ONE snapshot
+  copy taken at scrape start, so a node dying mid-scrape can never
+  produce a half-mutated exposition.
 
 Metric vocabulary: every counter key that rides heartbeats appears as
 ``tfos_<key>_total`` (counter) or ``tfos_<key>`` (gauge, for ``_hwm`` /
@@ -47,7 +48,7 @@ from tensorflowonspark_tpu.metrics import STEP_MS_BUCKETS
 logger = logging.getLogger(__name__)
 
 __all__ = ["SampleRing", "render_prometheus", "ObservatoryServer",
-           "DEFAULT_RING_CAPACITY"]
+           "effective_window", "build_info", "DEFAULT_RING_CAPACITY"]
 
 #: samples kept per node (at 1 s heartbeats: ~8.5 min of history)
 DEFAULT_RING_CAPACITY = 512
@@ -89,6 +90,62 @@ def _fmt_value(value):
     return str(value)
 
 
+def effective_window(samples):
+    """Trim ``samples`` (``[(ts, counters), ...]`` newest-last) to the
+    suffix after the most recent counter RESET.
+
+    A replacement executor re-registers into the same slot with fresh
+    zeroed counters, so a windowed first/last delta spanning the handover
+    goes negative.  A reset is detected when any summing counter key
+    present in both adjacent samples decreases; the window restarts at the
+    newer sample, so rates reflect only the current incarnation.
+    """
+    if len(samples) < 2:
+        return list(samples)
+    start = 0
+    for i in range(1, len(samples)):
+        prev, cur = samples[i - 1][1], samples[i][1]
+        if not isinstance(prev, dict) or not isinstance(cur, dict):
+            continue
+        for key, v1 in cur.items():
+            if key.endswith(_GAUGE_SUFFIXES):
+                continue
+            if isinstance(v1, bool) or not isinstance(v1, (int, float)):
+                continue
+            v0 = prev.get(key)
+            if (isinstance(v0, (int, float)) and not isinstance(v0, bool)
+                    and v1 < v0):
+                start = i
+                break
+    return list(samples[start:])
+
+
+def build_info():
+    """Static build/runtime facts for the ``tfos_build_info`` gauge.
+
+    Reads jax strictly through ``sys.modules`` and only inspects
+    already-initialized backends — a metrics scrape must never be the
+    thing that triggers backend bring-up on the driver.
+    """
+    import sys
+
+    from tensorflowonspark_tpu import __version__
+
+    info = {"version": __version__,
+            "python": "%d.%d.%d" % sys.version_info[:3]}
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        info["jax"] = getattr(jax, "__version__", "unknown")
+        try:
+            from jax._src import xla_bridge
+            backends = getattr(xla_bridge, "_backends", None) or {}
+            if backends:
+                info["backend"] = ",".join(sorted(backends))
+        except Exception:
+            pass
+    return info
+
+
 class SampleRing(object):
     """Bounded per-node ring of timestamped counter samples.
 
@@ -127,15 +184,24 @@ class SampleRing(object):
         For each summing counter key (gauge-suffix keys are skipped), the
         delta between the newest sample and the oldest sample inside the
         window, over their timestamp span.  Nodes with fewer than two
-        in-window samples contribute nothing.  Negative deltas (a restarted
-        node whose counters reset) are clamped to zero rather than reported
-        as a negative rate.
+        in-window samples contribute nothing.  When a replacement node's
+        zeroed counters reset the series mid-window, the window restarts
+        at the reset (:func:`effective_window`) so rates describe the
+        current incarnation instead of going negative; until the new
+        incarnation has two samples, the raw clamped window stands in
+        (reset keys read 0.0).
         """
         out = {}
         now = time.time()
         for node_id, ring in self.series().items():
-            in_window = [(ts, c) for ts, c in ring
-                         if now - ts <= window_secs]
+            raw = [(ts, c) for ts, c in ring if now - ts <= window_secs]
+            in_window = effective_window(raw)
+            if len(in_window) < 2:
+                # A reset with only one sample after it can't yield a
+                # current-incarnation rate yet; fall back to the raw
+                # window, whose clamped deltas report the reset keys as
+                # 0.0 (never negative) until a second sample lands.
+                in_window = raw
             if len(in_window) < 2:
                 continue
             (t0, c0), (t1, c1) = in_window[0], in_window[-1]
@@ -225,18 +291,28 @@ def _render_histogram(fams, executor, counters):
 
 
 def render_prometheus(snapshot, ring=None, window_secs=60.0,
-                      scrapes=None):
+                      scrapes=None, alert_counts=None, info=None):
     """Prometheus text exposition (0.0.4) from one metrics snapshot.
 
     ``snapshot`` is the ``{"nodes": {id: counters}, "aggregate": {...}}``
     shape of ``Server.metrics_snapshot()`` — the caller takes it ONCE and
     hands it in, so the exposition is internally consistent even while
     nodes die underneath the scrape.  ``ring`` (a :class:`SampleRing`)
-    contributes windowed rate gauges.
+    contributes windowed rate gauges; ``alert_counts`` (``{rule: n}``,
+    typically ``Watchtower.alert_counts``) the ``tfos_alerts_total``
+    family; ``info`` (:func:`build_info`) the ``tfos_build_info`` gauge.
     """
     nodes = (snapshot or {}).get("nodes") or {}
     fams = _Families()
 
+    if info:
+        labels = ",".join('%s="%s"' % (_NAME_BAD.sub("_", str(k)),
+                                       _escape_label(v))
+                          for k, v in sorted(info.items()))
+        fams.add("tfos_build_info", "gauge",
+                 "Build/runtime identity of this observatory "
+                 "(value is always 1).",
+                 "tfos_build_info{%s} 1" % labels)
     fams.add("tfos_nodes", "gauge",
              "Nodes currently contributing metric snapshots.",
              "tfos_nodes %d" % len(nodes))
@@ -244,6 +320,13 @@ def render_prometheus(snapshot, ring=None, window_secs=60.0,
         fams.add("tfos_scrapes_total", "counter",
                  "Scrapes served by this observatory endpoint.",
                  "tfos_scrapes_total %d" % scrapes)
+    if alert_counts:
+        for rule in sorted(alert_counts):
+            fams.add("tfos_alerts_total", "counter",
+                     "Watchtower alerts fired, by rule.",
+                     'tfos_alerts_total{rule="%s"} %s'
+                     % (_escape_label(rule),
+                        _fmt_value(alert_counts[rule])))
 
     for executor in sorted(nodes):
         counters = nodes[executor]
@@ -299,18 +382,22 @@ class ObservatoryServer(object):
     def __init__(self, snapshot_fn, ring=None, status_fn=None,
                  host="0.0.0.0", port=0, window_secs=60.0,
                  profile_fn=None, profiler_addresses_fn=None,
-                 capture_status_fn=None):
+                 capture_status_fn=None, watchtower=None):
         """``profile_fn(duration_ms=, steps=)`` backs ``GET /profile``
         (typically ``CaptureCoordinator.trigger``; 503 when absent).
         ``profiler_addresses_fn`` / ``capture_status_fn`` enrich ``/status``
         with the per-host ``jax.profiler`` endpoints and the latest capture
         state — lazy callables, because the observatory starts before the
-        roster exists."""
+        roster exists.  ``watchtower`` (a ``watchtower.Watchtower``) backs
+        ``GET /alerts``, the ``/status`` watchtower block, and the
+        ``tfos_alerts_total`` counters on ``/metrics``."""
         self._snapshot_fn = snapshot_fn
         self._status_fn = status_fn
         self._profile_fn = profile_fn
         self._profiler_addresses_fn = profiler_addresses_fn
         self._capture_status_fn = capture_status_fn
+        self.watchtower = watchtower
+        self._build_info = None
         self.ring = ring if ring is not None else SampleRing()
         self._window_secs = window_secs
         self._host = host
@@ -329,9 +416,46 @@ class ObservatoryServer(object):
         except Exception:
             logger.warning("observatory: snapshot failed", exc_info=True)
             snapshot = {}
+        if self._build_info is None:
+            try:
+                self._build_info = build_info()
+            except Exception:
+                self._build_info = {}
+        alert_counts = None
+        if self.watchtower is not None:
+            try:
+                alert_counts = self.watchtower.alert_counts()
+            except Exception:
+                alert_counts = None
         return render_prometheus(snapshot, ring=self.ring,
                                  window_secs=self._window_secs,
-                                 scrapes=self._scrapes)
+                                 scrapes=self._scrapes,
+                                 alert_counts=alert_counts,
+                                 info=self._build_info)
+
+    def _alerts_json(self, query):
+        if self.watchtower is None:
+            return 503, json.dumps(
+                {"error": "watchtower is not enabled on this cluster"})
+        import urllib.parse
+
+        params = urllib.parse.parse_qs(query or "")
+        try:
+            limit = int(params["limit"][0]) if params.get("limit") else None
+        except ValueError:
+            return 400, json.dumps({"error": "limit must be an integer"})
+        try:
+            payload = {
+                "time": time.time(),
+                "alerts": self.watchtower.alerts(limit=limit),
+                "alert_counts": self.watchtower.alert_counts(),
+                "suspects": {ex: a.get("rule") for ex, a
+                             in self.watchtower.suspects().items()},
+            }
+        except Exception as e:
+            logger.exception("observatory: /alerts failed")
+            return 500, json.dumps({"error": repr(e)})
+        return 200, json.dumps(payload, default=str)
 
     def _status_json(self):
         try:
@@ -366,6 +490,11 @@ class ObservatoryServer(object):
                 payload["last_capture"] = self._capture_status_fn()
             except Exception:
                 payload["last_capture"] = None
+        if self.watchtower is not None:
+            try:
+                payload["watchtower"] = self.watchtower.status()
+            except Exception:
+                payload["watchtower"] = None
         # tf_status may hold arbitrary user values; never let one break
         # the endpoint
         return json.dumps(payload, default=str)
@@ -424,8 +553,13 @@ class ObservatoryServer(object):
                     code, text = observatory._profile_response(query)
                     body = text.encode("utf-8")
                     ctype = "application/json"
+                elif path in ("/alerts", "/alerts/"):
+                    code, text = observatory._alerts_json(query)
+                    body = text.encode("utf-8")
+                    ctype = "application/json"
                 elif path == "/":
-                    body = b"tfos observatory: /metrics /status /profile\n"
+                    body = (b"tfos observatory: /metrics /status "
+                            b"/profile /alerts\n")
                     ctype = "text/plain; charset=utf-8"
                 else:
                     self.send_error(404)

@@ -291,6 +291,17 @@ class Server(MessageSocket):
         """Snapshot of clean-deregistration reasons, keyed by executor id."""
         return dict(self._byes)
 
+    def beat_ages(self):
+        """Seconds since each tracked node's last heartbeat, keyed by
+        executor id (read-only snapshot; dead nodes excluded).  The
+        watchtower's heartbeat-miss rule reads this to flag a silent node
+        BEFORE the liveness fence (``heartbeat_misses`` beats) declares it
+        dead."""
+        now = time.monotonic()
+        return {str(ex): now - last
+                for ex, (last, _) in list(self._beats.items())
+                if ex not in self._dead}
+
     def metrics_snapshot(self):
         """Cluster metrics view from the HBEAT payloads: per-node snapshots
         plus the merged aggregate (sums, ``_hwm`` keys by max)."""

@@ -405,12 +405,41 @@ class Tracer(object):
                 "counters": self.counters_snapshot(),
                 "extra": extra or {},
             }
+            # Registered flight sources (e.g. the driver's sample-ring tail
+            # and watchtower alert log): each guarded individually, so one
+            # broken source cannot cost the stacks that motivated the dump.
+            for name, fn in list(_flight_sources.items()):
+                try:
+                    payload["extra"][name] = fn()
+                except Exception as e:
+                    payload["extra"][name] = "unavailable: %r" % (e,)
             path = self._write_json(self._path("flight"), payload)
             logger.warning("telemetry flight record (%s) -> %s", reason, path)
             return path
         except Exception as e:
             logger.warning("telemetry flight dump failed: %s", e)
             return None
+
+
+# -- flight-source registry ----------------------------------------------
+
+# name -> zero-arg callable returning a JSON-ready object, merged into every
+# flight record's "extra" block (SIGUSR1 / stall dumps).  The driver
+# registers the observatory sample-ring tail and the watchtower alert log
+# here, so hang forensics include the metric trajectory leading into the
+# stall.  Process-global like the tracer itself; sources must be cheap and
+# signal-safe (copies of in-memory state, no I/O).
+_flight_sources = {}
+
+
+def register_flight_source(name, fn):
+    """Register/replace a named flight-record source (see ``Tracer.dump``)."""
+    _flight_sources[str(name)] = fn
+
+
+def unregister_flight_source(name):
+    """Remove a flight-record source; unknown names are a no-op."""
+    _flight_sources.pop(str(name), None)
 
 
 # -- process-global tracer ----------------------------------------------

@@ -12,6 +12,7 @@ from Spark partitions.
 import contextlib
 import dataclasses
 import logging
+import math
 import os
 import time
 from typing import Any, Callable, Optional
@@ -220,14 +221,21 @@ class Trainer(object):
                 if jnp.issubdtype(x.dtype, jnp.floating) else x, batch)
 
         def apply_update(state, grads, loss, aux, new_extra):
-            """Shared tail: one optimizer update + next TrainState."""
+            """Shared tail: one optimizer update + next TrainState.  The
+            global grad norm is computed INSIDE the jitted step (one
+            norm-reduce, negligible next to the matmuls) and carried out
+            as a device scalar alongside the user aux; :meth:`step`
+            separates them again, so the user-visible aux contract is
+            unchanged and nothing syncs until a TimeHistory window
+            boundary reads it (training-health telemetry)."""
             import optax
 
+            grad_norm = optax.global_norm(grads)
             updates, new_opt = self.optimizer.update(
                 grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             return (TrainState(state.step + 1, new_params, new_opt, new_extra),
-                    loss, aux)
+                    loss, (aux, grad_norm))
 
         def train_step_accum(state, batch, mask):
             """One optimizer step from ``accum_steps`` sequential microbatch
@@ -321,6 +329,17 @@ class Trainer(object):
         self._step_bytes = None
         self._compile_secs = None
         self._roofline = None
+        # Training-health telemetry, observed ONLY at TimeHistory window
+        # boundaries (the one place the pipeline already syncs): last
+        # finite loss / grad-norm gauges plus cumulative nonfinite tallies.
+        # The watchtower's nonfinite rule and the heartbeat channel read
+        # these via counters_snapshot.
+        self._health_grad_norm = None  # device scalar from the last step
+        self._health_windows = 0       # boundary observations folded in
+        self._health_loss = None       # last FINITE loss
+        self._health_grad = None       # last finite grad norm
+        self._nonfinite_loss = 0
+        self._nonfinite_grad = 0
 
     def counters_snapshot(self):
         """Flat overlap + goodput counters for heartbeat payloads /
@@ -381,6 +400,20 @@ class Trainer(object):
             snap["train_compile_us_max"] = int(self._compile_secs * 1e6)
         if self._step_bytes:
             snap["train_step_bytes_max"] = self._step_bytes
+        # Training-health block (first window boundary onward):
+        # train_health_windows boundary observations, train_loss_max /
+        # train_grad_norm_max the last FINITE readings (gauges — never
+        # NaN), train_nonfinite_loss / train_nonfinite_grad cumulative
+        # tallies of nonfinite observations (the watchtower's nonfinite
+        # rule fires on any increase).
+        if self._health_windows:
+            snap["train_health_windows"] = self._health_windows
+            snap["train_nonfinite_loss"] = self._nonfinite_loss
+            snap["train_nonfinite_grad"] = self._nonfinite_grad
+            if self._health_loss is not None:
+                snap["train_loss_max"] = self._health_loss
+            if self._health_grad is not None:
+                snap["train_grad_norm_max"] = round(self._health_grad, 6)
         attrib = self.attribution_report()
         if attrib:
             for name, pct in attrib.items():
@@ -429,6 +462,7 @@ class Trainer(object):
             # reset_history / first use: start from this recorder's origin
             self._acct_history = hist
             self._windows_seen = 1
+        before_windows = self._windows_seen
         log = hist.timestamp_log
         while self._windows_seen < len(log):
             s0, t0 = log[self._windows_seen - 1]
@@ -453,6 +487,43 @@ class Trainer(object):
             mfu = metrics_mod.mfu_from_step_time(hist.step_flops, step_s)
             if mfu is not None:
                 self._mfu_pct = 100.0 * mfu
+        if self._windows_seen != before_windows:
+            self._sync_health(hist)
+
+    def _sync_health(self, hist):
+        """Fold one window-boundary health observation: the boundary just
+        forced a device sync, so reading the synced loss (and the buffered
+        grad-norm device scalar) here adds no pipeline stall.  Nonfinite
+        observations bump the cumulative tallies; the published gauges
+        keep the last FINITE values, so heartbeat payloads and Prometheus
+        scrapes never carry NaN."""
+        self._health_windows += 1
+        val = getattr(hist, "last_synced_value", None)
+        if val is not None:
+            try:
+                import numpy as np
+
+                arr = np.asarray(val, dtype=np.float64).ravel()
+            except (TypeError, ValueError):
+                arr = None
+            if arr is not None and arr.size:
+                bad = int((~np.isfinite(arr)).sum())
+                if bad:
+                    self._nonfinite_loss += bad
+                last = float(arr[-1])
+                if math.isfinite(last):
+                    self._health_loss = last
+        gnorm, self._health_grad_norm = self._health_grad_norm, None
+        if gnorm is not None:
+            try:
+                gval = float(jax.device_get(gnorm))
+            except (TypeError, ValueError):
+                gval = None
+            if gval is not None:
+                if math.isfinite(gval):
+                    self._health_grad = gval
+                else:
+                    self._nonfinite_grad += 1
 
     def _get_multi_step(self, k):
         """Jitted program running ``k`` train steps in ONE dispatch via
@@ -642,7 +713,12 @@ class Trainer(object):
             first = jax.tree_util.tree_leaves(batch)[0]
             mask = jnp.ones((first.shape[0],), jnp.float32)
         self._ensure_history(batch, mask)
-        self.state, loss, aux = self._train_step(self.state, batch, mask)
+        self.state, loss, packed = self._train_step(self.state, batch, mask)
+        # apply_update rides the grad norm out next to the user aux; keep
+        # it as an un-synced device scalar until a window boundary reads it
+        # (multi_step's scan discards aux, so the gauge follows single-step
+        # dispatches only).
+        aux, self._health_grad_norm = packed
         # Passing the loss lets TimeHistory sync on device completion at
         # window boundaries (honest ms/step + MFU under async dispatch);
         # within a window steps still pipeline.
@@ -683,10 +759,15 @@ class Trainer(object):
         The returned stats carry ``stats["overlap"]`` — this trainer's
         dispatch-gap counters merged with the feed's ``infeed_*`` tallies
         (see :meth:`counters_snapshot`)."""
+        from tensorflowonspark_tpu import fault as fault_mod
         from tensorflowonspark_tpu import telemetry
 
         tracer = telemetry.get_tracer()
         guard_level = _resolve_transfer_guard(transfer_guard)
+        # Chaos hooks (null-object when TFOS_FAULT_SPEC is unset: one env
+        # lookup here, one attribute call per dispatch): per-step straggler
+        # sleep and one-shot NaN batch corruption.
+        injector = fault_mod.from_env()
         # Ride heartbeats like the feeds do (duck-typed counters_snapshot;
         # guarded for standalone use outside the node runtime).
         try:
@@ -720,6 +801,8 @@ class Trainer(object):
         pop_flow = getattr(sharded_feed, "pop_dispatch_flow", None)
         prev_return = None
         for kind, batch, mask in source:
+            injector.on_step(steps_done)
+            batch = injector.corrupt_batch(batch, steps_done)
             start = time.perf_counter()
             if prev_return is not None:
                 gap_us = int((start - prev_return) * 1e6)

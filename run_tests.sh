@@ -43,11 +43,15 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # watchtower catches an injected straggler and an injected NaN loss live
 # (correctly attributed on /alerts, /metrics, /status and as trace
 # instants) and that metrics_replay.py re-derives the same alerts from
-# the on-disk journal after the cluster is gone
+# the on-disk journal after the cluster is gone, and prove the caching
+# tier pays: 2 cache-armed worker subprocesses serving a 2-epoch job with
+# >=90% epoch-2 cache hits, compressed colv1 frames, and a nonzero
+# wire-compression ratio on a live /metrics scrape
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
 python scripts/ci_assert_dataservice.py
+python scripts/ci_assert_cache.py
 python scripts/ci_assert_overlap.py
 python scripts/ci_assert_observatory.py
 python scripts/ci_assert_profiling.py

@@ -294,6 +294,11 @@ HEADLINE_METRICS = (
     ("resnet50_compile_secs", "resnet", "lower"),
     ("transformer_lm_roofline_frac", "transformer", "higher"),
     ("transformer_lm_compile_secs", "transformer", "lower"),
+    # data-service caching tier (absent pre-round-10, skipped by run_diff)
+    ("dataservice_cached_speedup", "dataservice_cached_epoch", "higher"),
+    ("dataservice_epoch2_items_per_sec", "dataservice_cached_epoch",
+     "higher"),
+    ("wire_compress_ratio", "dataservice_cached_epoch", "higher"),
 )
 
 

@@ -3,9 +3,13 @@
 Boots an in-process dispatcher plus TWO feed-worker SUBPROCESSES (the real
 ``python -m tensorflowonspark_tpu.dataservice_worker`` entry) and TWO
 consumers on localhost.  One worker carries ``TFOS_FAULT_SPEC
-{"kill_after_items": 60}`` — a genuine SIGKILL that lands MID-split (after
-a data block, before its ``split_end``), so the job cannot complete until
-the dead worker is fenced and its in-flight split re-pools.  The gate
+{"kill_after_items": 10}`` — a genuine SIGKILL that lands MID-split (after
+a data block, before its ``split_end``) on the FIRST split that worker
+wins, so the job cannot complete until the dead worker is fenced and its
+in-flight split re-pools.  (The threshold sits under one split's row
+count on purpose: a higher one made the gate racy — on a loaded host the
+other worker could drain this tiny job before the armed worker streamed
+enough items to die.)  The gate
 asserts the whole chain inside a 10s budget:
 
 1. both workers register and stream colv1 frames,
@@ -64,10 +68,18 @@ def main():
                                         heartbeat_misses=2, host="127.0.0.1")
     addr = disp.start()
     procs = [_spawn_worker(addr, "ci-w0",
-                           fault_spec={"kill_after_items": 60}),
+                           fault_spec={"kill_after_items": 10}),
              _spawn_worker(addr, "ci-w1")]
     t0 = time.time()
     try:
+        # both workers must be on the roster before the job starts: on a
+        # loaded host a slow python startup would otherwise let the other
+        # worker drain this tiny job alone, and the fault-armed worker
+        # would never reach its kill threshold
+        while len(dataservice.DispatcherClient(addr).workers()) < 2:
+            assert time.time() - t0 < BUDGET_SECS, \
+                "workers never registered"
+            time.sleep(0.05)
         feeds = [dataservice.ServiceFeed(
             addr, splits, job_name="ci", mode=dataservice.SHARD_DYNAMIC,
             consumer_id="ci-c{}".format(i), timeout=BUDGET_SECS)
@@ -104,7 +116,9 @@ def main():
             "element totals wrong: {} items vs {} expected".format(
                 len(combined), len(expect))
         dupes = sum(f.split_dupes for f in feeds)
-        colv1 = sum(f.wire_formats.get("colv1", 0) for f in feeds)
+        colv1 = sum(n for f in feeds
+                    for fmt, n in f.wire_formats.items()
+                    if fmt.startswith("colv1"))
         assert colv1 > 0, "transport never used colv1 frames"
         for f in feeds:
             f.terminate()

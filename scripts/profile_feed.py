@@ -205,6 +205,11 @@ for n_workers in (1, 2):
     report("H%d ServiceFeed (%d worker%s, colv1/TCP)"
            % (n_workers + 1, n_workers, "s" if n_workers > 1 else ""),
            h_secs, h_n)
+    # negotiated wire compression on the links (1.0 = every column stayed
+    # raw — the pay-off sampler declined, e.g. random float mantissas)
+    h_snap = sf.counters_snapshot()
+    print("   wire_compress_ratio: {}  formats: {}".format(
+        h_snap.get("wire_compress_ratio_max", 1.0), dict(sf.wire_formats)))
     sf.terminate()
     for w in ws:
         w.stop()

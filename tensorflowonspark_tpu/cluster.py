@@ -790,14 +790,20 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                     "/alerts at http://%s:%d", addr[0], addr[1])
 
     # Normalize the data-service spec to {"dispatcher": [host, port]} for
-    # the JSON hop to executors (ctx.get_service_feed consumes it).
+    # the JSON hop to executors (ctx.get_service_feed consumes it).  An
+    # optional "codecs" preference list survives normalization so a driver
+    # can pin the wire compression its consumers offer at dial.
     if data_service is not None:
+        codecs = (data_service.get("codecs")
+                  if isinstance(data_service, dict) else None)
         addr = (data_service.get("dispatcher")
                 if isinstance(data_service, dict) else data_service)
         if isinstance(addr, str):
             host, _, port = addr.rpartition(":")
             addr = (host, int(port))
         data_service = {"dispatcher": [addr[0], int(addr[1])]}
+        if codecs is not None:
+            data_service["codecs"] = list(codecs)
 
     cluster_meta = {
         "id": "{:x}".format(random.getrandbits(64)),

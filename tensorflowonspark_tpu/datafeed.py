@@ -433,7 +433,10 @@ class DataFeed(object):
 
         Schema: ``feed_items`` (rows delivered), ``feed_stall_secs`` (time
         blocked on an empty queue), ``wire_<fmt>`` (chunks per transport —
-        ``wire_colv1``/``wire_pickle``/``wire_queue``).
+        ``wire_colv1``/``wire_pickle``/``wire_queue``; data-service feeds
+        additionally mint ``wire_colv1+<codec>`` keys for compressed
+        streams plus the ``dataservice_cache_*`` / ``wire_compress_*``
+        vocabulary, see ``ServiceFeed.counters_snapshot``).
         """
         snap = {"feed_items": self.items_consumed,
                 "feed_stall_secs": round(self.stall_secs, 6)}

@@ -58,6 +58,7 @@ colv1 frame, kind 2 pickled rows.
 import collections
 import json
 import logging
+import os
 import pickle
 import queue as _queue
 import select
@@ -374,6 +375,7 @@ class DispatcherServer(MessageSocket):
         self._workers = {}   # worker_id -> {"worker_id","host","port"}
         self._beats = {}     # worker_id -> last beat (monotonic)
         self._dead = {}      # worker_id -> death description
+        self._worker_metrics = {}  # worker_id -> latest HBEAT counters
         self._lock = threading.RLock()
         self._stopping = False
         self._socket = None
@@ -390,6 +392,17 @@ class DispatcherServer(MessageSocket):
         """Fenced-worker descriptions keyed by worker id."""
         with self._lock:
             return dict(self._dead)
+
+    def worker_metrics(self):
+        """Latest per-worker HBEAT counters plus a merged aggregate.
+
+        Returns ``{"workers": {worker_id: counters}, "aggregate": counters}``
+        where the aggregate follows :func:`telemetry.merge_counters`
+        semantics (``_hwm``/``_max`` keys merge by max, the rest sum)."""
+        with self._lock:
+            per = {w: dict(c) for w, c in self._worker_metrics.items()}
+        return {"workers": per,
+                "aggregate": telemetry.merge_counters(per.values())}
 
     def job_status(self, name):
         """Ledger snapshot for one job (``None`` if unknown)."""
@@ -475,6 +488,10 @@ class DispatcherServer(MessageSocket):
                     # (mirrors reservation.Server._beat)
                     if worker_id is not None:
                         self._beats[worker_id] = time.monotonic()
+                        beat_metrics = data.get("metrics")
+                        if isinstance(beat_metrics, dict):
+                            self._worker_metrics.setdefault(
+                                worker_id, {}).update(beat_metrics)
                     self.send(sock, {"type": "OK"})
             elif mtype == "BYE":
                 worker_id = data.get("executor_id")
@@ -752,6 +769,237 @@ def _default_retry_policy():
 
 
 # ---------------------------------------------------------------------------
+# Worker-side chunk cache
+# ---------------------------------------------------------------------------
+
+def _env_cache_bytes():
+    raw = os.environ.get("TFOS_DS_CACHE_BYTES", "").strip()
+    if not raw:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring non-integer TFOS_DS_CACHE_BYTES=%r", raw)
+        return None
+
+
+# Spill-file frame record: kind (u8), item count (u32), payload length (u64).
+_SPILL_REC = struct.Struct("<BIQ")
+
+
+class _FrameCache(object):
+    """Byte-budgeted LRU of serialized split streams (the tf.data-service
+    paper's source cache, at the worker).
+
+    The unit of caching is the exact ``(kind, payload, items)`` frame
+    sequence a cold serve produced for one split — colv1 frames
+    *post-compression*, pickle-fallback frames included — so an epoch ≥ 2
+    (or post-re-pool) serve replays bytes without touching ``FileFeed``,
+    the row decoder, or the wire codec.  Entries are keyed by the split's
+    source identity ``(path, wire codec)``, which subsumes (job
+    signature, split index): a worker's serialized frames depend only on
+    the file's content and the negotiated codec, so two jobs over the
+    same dataset share entries while different datasets never collide.
+    Every lookup re-validates the source file's ``(size, mtime_ns)``
+    captured when the cold read *started*; a touched/resized source drops
+    the entry (tallied as an invalidation) and the split is re-decoded.
+
+    Overflow: LRU over resident bytes.  With ``spill_dir`` set, evicted
+    entries spill to disk under it (their own LRU, ``spill_budget``
+    bytes, default 4× the memory budget) and a spill hit promotes the
+    entry back to memory; without it they are dropped.  All bookkeeping
+    sits behind one lock — serve streams are concurrent, frame lists are
+    immutable once inserted.
+    """
+
+    def __init__(self, max_bytes, spill_dir=None, spill_budget=None):
+        self.max_bytes = int(max_bytes)
+        self.spill_dir = spill_dir
+        self.spill_budget = (int(spill_budget) if spill_budget is not None
+                             else 4 * self.max_bytes)
+        self._entries = collections.OrderedDict()  # key -> entry (resident)
+        self._spilled = collections.OrderedDict()  # key -> entry (on disk)
+        self._resident = 0
+        self._spilled_bytes = 0
+        self._seq = 0
+        self._lock = threading.Lock()
+        # tallies (read cross-thread; see FeedWorker heartbeat metrics)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.spills = 0
+        self.spill_hits = 0
+        self.invalidations = 0
+        self.uncacheable = 0
+        self.bytes_served = 0
+
+    @staticmethod
+    def signature(path):
+        """``(size, mtime_ns)`` of the source file, or ``None`` when it
+        cannot be stat'ed (synthetic reader paths): such entries skip
+        freshness validation and rely on LRU turnover alone."""
+        try:
+            st = os.stat(path)
+        except (OSError, TypeError, ValueError):
+            return None
+        return (st.st_size, getattr(st, "st_mtime_ns", st.st_mtime))
+
+    # -- internal (caller holds the lock) ----------------------------------
+
+    def _drop(self, key, entry):
+        self._entries.pop(key, None)
+        self._spilled.pop(key, None)
+        if entry.get("frames") is not None:
+            self._resident -= entry["nbytes"]
+        spill = entry.get("spill")
+        if spill:
+            self._spilled_bytes -= entry["nbytes"]
+            try:
+                os.unlink(spill)
+            except OSError:
+                pass
+
+    def _spill_entry(self, key, entry):
+        """Move a resident entry to disk; False when spill is off/fails."""
+        if (self.spill_dir is None
+                or entry["nbytes"] > self.spill_budget):
+            return False
+        path = os.path.join(self.spill_dir,
+                            "split-{:08d}.cache".format(self._seq))
+        self._seq += 1
+        try:
+            os.makedirs(self.spill_dir, exist_ok=True)
+            with open(path, "wb") as f:
+                for kind, payload, items in entry["frames"]:
+                    f.write(_SPILL_REC.pack(kind, items, len(payload)))
+                    f.write(payload)
+        except OSError as e:
+            logger.warning("chunk cache: spill of %r failed (%s)",
+                           entry["path"], e)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        entry["frames"] = None
+        entry["spill"] = path
+        self._spilled[key] = entry
+        self._spilled_bytes += entry["nbytes"]
+        while self._spilled_bytes > self.spill_budget and self._spilled:
+            old_key, old = self._spilled.popitem(last=False)
+            self._drop(old_key, old)
+        return True
+
+    def _load_spill(self, entry):
+        """Frames list read back from an entry's spill file, or ``None``."""
+        try:
+            with open(entry["spill"], "rb") as f:
+                frames = []
+                while True:
+                    rec = f.read(_SPILL_REC.size)
+                    if not rec:
+                        return frames
+                    kind, items, length = _SPILL_REC.unpack(rec)
+                    payload = f.read(length)
+                    if len(payload) != length:
+                        raise OSError("truncated spill record")
+                    frames.append((kind, payload, items))
+        except OSError as e:
+            logger.warning("chunk cache: spill read-back of %r failed (%s)",
+                           entry["path"], e)
+            return None
+
+    def _evict_overflow(self):
+        while self._resident > self.max_bytes and self._entries:
+            key, entry = self._entries.popitem(last=False)
+            self._resident -= entry["nbytes"]
+            self.evictions += 1
+            if self._spill_entry(key, entry):
+                self.spills += 1
+
+    # -- serve-thread API --------------------------------------------------
+
+    def lookup(self, path, codec):
+        """The cached frame list for ``(path, codec)``, or ``None`` (miss /
+        stale / unreadable spill).  A hit refreshes LRU order; a spilled
+        hit is promoted back to memory first."""
+        key = (path, codec or "none")
+        with self._lock:
+            entry = self._entries.get(key) or self._spilled.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            if (entry["sig"] is not None
+                    and self.signature(path) != entry["sig"]):
+                self._drop(key, entry)
+                self.invalidations += 1
+                self.misses += 1
+                return None
+            if entry["frames"] is None:
+                frames = self._load_spill(entry)
+                if frames is None:
+                    self._drop(key, entry)
+                    self.misses += 1
+                    return None
+                self.spill_hits += 1
+                self._spilled.pop(key, None)
+                self._spilled_bytes -= entry["nbytes"]
+                try:
+                    os.unlink(entry["spill"])
+                except OSError:
+                    pass
+                entry["frames"], entry["spill"] = frames, None
+                self._entries[key] = entry
+                self._resident += entry["nbytes"]
+            self._entries.move_to_end(key)
+            self._evict_overflow()
+            self.hits += 1
+            self.bytes_served += entry["nbytes"]
+            return entry["frames"]
+
+    def put(self, path, codec, sig, frames):
+        """Insert a completely-served split's frames (``sig`` captured
+        before the cold read started).  Returns how many entries this
+        insert pushed out of memory — the per-stream eviction delta the
+        worker reports on ``split_end``."""
+        nbytes = sum(len(p) for _, p, _ in frames)
+        key = (path, codec or "none")
+        with self._lock:
+            old = self._entries.get(key) or self._spilled.get(key)
+            if old is not None:
+                self._drop(key, old)
+            if nbytes > self.max_bytes:
+                self.uncacheable += 1
+                return 0
+            before = self.evictions
+            self._entries[key] = {"path": path, "sig": sig,
+                                  "frames": list(frames), "nbytes": nbytes,
+                                  "spill": None}
+            self._resident += nbytes
+            self._evict_overflow()
+            return self.evictions - before
+
+    # -- observability -----------------------------------------------------
+
+    def resident_bytes(self):
+        with self._lock:
+            return self._resident
+
+    def counters_flat(self):
+        """The ``dataservice_cache_*`` heartbeat vocabulary (``_max``
+        suffix = gauge, everything else cumulative counters)."""
+        with self._lock:
+            return {"dataservice_cache_hit": self.hits,
+                    "dataservice_cache_miss": self.misses,
+                    "dataservice_cache_bytes": self.bytes_served,
+                    "dataservice_cache_evictions": self.evictions,
+                    "dataservice_cache_spills": self.spills,
+                    "dataservice_cache_spill_hits": self.spill_hits,
+                    "dataservice_cache_invalidations": self.invalidations,
+                    "dataservice_cache_resident_max": self._resident}
+
+
+# ---------------------------------------------------------------------------
 # FeedWorker
 # ---------------------------------------------------------------------------
 
@@ -769,14 +1017,26 @@ class FeedWorker(object):
     ``TFOS_WIRE_FORMAT=pickle`` A/B knob.
 
     Liveness: a ``HeartbeatSender`` pointed at the dispatcher (the
-    ``HBEAT``/``BYE`` wire shapes are shared with the rendezvous).  Chaos:
-    ``fault.FaultInjector`` hooks fire per block (``kill_after_items``)
-    and per finished split (``kill_after_splits``).
+    ``HBEAT``/``BYE`` wire shapes are shared with the rendezvous) carrying
+    the worker's cache/compression counters as its piggybacked metrics.
+    Chaos: ``fault.FaultInjector`` hooks fire per block
+    (``kill_after_items``) and per finished split (``kill_after_splits``)
+    — on cached replays too, so chaos coverage survives the cache.
+
+    ``cache_bytes`` arms the worker chunk cache (:class:`_FrameCache`):
+    the serialized frames of each completely-served split are kept under
+    a byte-budgeted LRU and replayed on later serves of the same source
+    (epoch ≥ 2, or a re-pooled split landing back on this worker),
+    skipping the reader and codec entirely.  ``None`` reads
+    ``TFOS_DS_CACHE_BYTES``; 0/unset disables.  ``cache_spill_dir``
+    additionally spills evicted entries to disk under the worker's work
+    dir.
     """
 
     def __init__(self, dispatcher_addr, row_reader=None, host="127.0.0.1",
                  port=0, worker_id=None, heartbeat_interval=1.0,
-                 use_process_pool=False, num_procs=2, retry_policy=None):
+                 use_process_pool=False, num_procs=2, retry_policy=None,
+                 cache_bytes=None, cache_spill_dir=None):
         self.dispatcher_addr = _addr_tuple(dispatcher_addr)
         self.row_reader = row_reader
         self.host = host
@@ -791,6 +1051,14 @@ class FeedWorker(object):
         self.splits_streamed = 0
         self.items_streamed = 0
         self.bytes_streamed = 0
+        if cache_bytes is None:
+            cache_bytes = _env_cache_bytes()
+        self.chunk_cache = (_FrameCache(cache_bytes,
+                                        spill_dir=cache_spill_dir)
+                            if cache_bytes else None)
+        # producer-side wire-compression accounting, incremented in place
+        # by wire.frame_bytes (raw_bytes / wire_bytes / cols_* / frames)
+        self.compress_stats = {}
         self._framed = wire.enabled()
         self._injector = fault.from_env()
         self._stop = threading.Event()
@@ -820,8 +1088,8 @@ class FeedWorker(object):
 
         self.retry_policy.call(_register)
         self._heartbeat = HeartbeatSender(
-            self.dispatcher_addr, self.worker_id,
-            self.heartbeat_interval).start()
+            self.dispatcher_addr, self.worker_id, self.heartbeat_interval,
+            metrics_provider=self._heartbeat_metrics).start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop,
             name="feedworker-accept-{}".format(self.worker_id), daemon=True)
@@ -884,6 +1152,12 @@ class FeedWorker(object):
                 raise DispatchError("stream hello must be a JSON frame")
             hello = json.loads(payload)
             job, consumer = hello["job"], hello["consumer"]
+            # Dial-time codec negotiation: the consumer's hello offers its
+            # codec names in preference order; the first one this worker
+            # supports compresses every colv1 frame on this stream (column-
+            # wise, pay-off sampled).  A hello without "codecs" — an older
+            # consumer — gets raw frames, byte-identical to before.
+            codec = wire.negotiate_codec(hello.get("codecs"))
             client = DispatcherClient(self.dispatcher_addr)
             while not self._stop.is_set():
                 task = client.request_task(job, self.worker_id, consumer)
@@ -897,7 +1171,8 @@ class FeedWorker(object):
                     for split, path in task["splits"]:
                         self._stream_split(conn, client, job, consumer,
                                            split, int(task.get("epoch", 0)),
-                                           path, flow=task.get("flow"))
+                                           path, flow=task.get("flow"),
+                                           codec=codec)
         except (EOFError, OSError) as e:
             logger.info("feed worker %s: stream closed (%s)",
                         self.worker_id, e)
@@ -930,7 +1205,7 @@ class FeedWorker(object):
                              reader_threads=1, shard=False)
 
     def _stream_split(self, conn, client, job, consumer, split, epoch, path,
-                      flow=None):
+                      flow=None, codec=None):
         # Reader faults (unreadable file, bad records) are kept separate
         # from socket faults: the reader calls sit in their own try so an
         # OSError from the filesystem is never mistaken for a dead stream.
@@ -941,37 +1216,73 @@ class FeedWorker(object):
             tracer.flow_step("dataservice/split_flow", flow,
                              leg="worker_serve", split=split,
                              worker_id=self.worker_id)
+        cached = (self.chunk_cache.lookup(path, codec)
+                  if self.chunk_cache is not None else None)
         with tracer.span("dataservice/split_stream", split=split,
-                         epoch=epoch, worker_id=self.worker_id):
+                         epoch=epoch, worker_id=self.worker_id,
+                         cache="hit" if cached is not None else "miss"):
             begin = {"type": "split_begin", "split": split, "epoch": epoch}
-            if flow:
-                begin["flow"] = flow
-            _send_json(conn, begin)
-            feed = None
-            try:
-                try:
-                    feed = self._make_feed(path)
-                    feed._ensure_started()
-                except Exception as e:
-                    self._abort_split(conn, client, job, consumer, split,
-                                      epoch, e)
-                    return
-                while not self._stop.is_set():
-                    try:
-                        block = feed._next_rows()
-                    except Exception as e:
-                        self._abort_split(conn, client, job, consumer,
-                                          split, epoch, e)
-                        return
-                    if block is None:
-                        break
-                    self._send_block(conn, block)
-            finally:
-                if feed is not None:
-                    feed.terminate()
             end = {"type": "split_end", "split": split, "epoch": epoch}
             if flow:
-                end["flow"] = flow
+                begin["flow"] = end["flow"] = flow
+            if codec:
+                begin["codec"] = codec
+            if self.chunk_cache is not None:
+                # the serve verdict rides both control frames so consumers
+                # tally dataservice_cache_* without a second channel
+                begin["cache"] = end["cache"] = (
+                    "hit" if cached is not None else "miss")
+            _send_json(conn, begin)
+            if cached is not None:
+                # replay the serialized frames: no FileFeed, no decode, no
+                # codec work — chaos hooks still fire per block/split
+                served = 0
+                for kind, payload, items in cached:
+                    if self._stop.is_set():
+                        break
+                    _send_frame(conn, kind, payload)
+                    self.items_streamed += items
+                    self.bytes_streamed += len(payload)
+                    served += len(payload)
+                    self._injector.on_items(items)
+                end["cache_bytes"] = served
+            else:
+                fill = [] if self.chunk_cache is not None else None
+                # freshness signature is captured BEFORE the read starts:
+                # a file mutated mid-read mismatches at the next lookup
+                sig = (_FrameCache.signature(path) if fill is not None
+                       else None)
+                feed = None
+                complete = False
+                try:
+                    try:
+                        feed = self._make_feed(path)
+                        feed._ensure_started()
+                    except Exception as e:
+                        self._abort_split(conn, client, job, consumer, split,
+                                          epoch, e)
+                        return
+                    while not self._stop.is_set():
+                        try:
+                            block = feed._next_rows()
+                        except Exception as e:
+                            self._abort_split(conn, client, job, consumer,
+                                              split, epoch, e)
+                            return
+                        if block is None:
+                            complete = True
+                            break
+                        self._send_block(conn, block, codec=codec,
+                                         record=fill)
+                finally:
+                    if feed is not None:
+                        feed.terminate()
+                if fill is not None and complete:
+                    evicted = self.chunk_cache.put(path, codec, sig, fill)
+                    if evicted:
+                        end["cache_evicted"] = evicted
+            if self.chunk_cache is not None:
+                end["cache_resident"] = self.chunk_cache.resident_bytes()
             _send_json(conn, end)
         self.splits_streamed += 1
         self._injector.on_split()
@@ -997,25 +1308,73 @@ class FeedWorker(object):
             logger.warning("feed worker %s: SPLIT_ERR refused (%s)",
                            self.worker_id, e)
 
-    def _send_block(self, conn, block):
+    def _send_block(self, conn, block, codec=None, record=None):
         payload = None
+        kind = _K_PICKLE
         if self._framed:
             chunk = marker.pack_columnar(block)
             if chunk is not None:
-                payload = wire.frame_chunk_bytes(chunk)
-        if payload is not None:
-            _send_frame(conn, _K_COLV1, payload)
-        else:
+                payload = wire.frame_chunk_bytes(chunk, codec=codec,
+                                                 stats=self.compress_stats)
+                kind = _K_COLV1
+        if payload is None:
             payload = pickle.dumps(block, protocol=pickle.HIGHEST_PROTOCOL)
-            _send_frame(conn, _K_PICKLE, payload)
+            kind = _K_PICKLE
+        _send_frame(conn, kind, payload)
+        if record is not None:
+            # the exact wire form (kind + serialized payload) is what the
+            # cache replays, so hits skip pack/frame/compress entirely
+            record.append((kind, payload, len(block)))
         self.items_streamed += len(block)
         self.bytes_streamed += len(payload)
         self._injector.on_items(len(block))
+
+    def _heartbeat_metrics(self):
+        """Counter snapshot riding worker HBEATs to the dispatcher (which
+        latches the latest per worker for ``worker_metrics()``)."""
+        out = {
+            "dataservice_worker_splits": self.splits_streamed,
+            "dataservice_worker_items": self.items_streamed,
+            "dataservice_worker_bytes": self.bytes_streamed,
+        }
+        if self.chunk_cache is not None:
+            out.update(self.chunk_cache.counters_flat())
+        stats = self.compress_stats
+        if stats.get("frames"):
+            out["wire_compress_raw_bytes"] = int(stats.get("raw_bytes", 0))
+            out["wire_compress_wire_bytes"] = int(stats.get("wire_bytes", 0))
+        return out
 
 
 # ---------------------------------------------------------------------------
 # ServiceFeed
 # ---------------------------------------------------------------------------
+
+def _resolve_codecs(codecs):
+    """Normalize a ``ServiceFeed(codecs=...)`` argument into the offer list
+    sent in the dial hello.  ``None`` defers to ``TFOS_WIRE_CODEC`` and then
+    to every codec this host supports; an explicit list is validated but
+    passed through (the worker drops names it can't honour)."""
+    if codecs is None:
+        env = os.environ.get("TFOS_WIRE_CODEC", "").strip()
+        if env:
+            if env.lower() in ("off", "0", "none", "pickle"):
+                return []
+            if not wire.codec_supported(env):
+                logger.warning("TFOS_WIRE_CODEC=%r is not supported on this "
+                               "host; offering no codecs", env)
+                return []
+            return [env]
+        return [c for c in wire.supported_codecs() if c != "none"]
+    out = []
+    for name in codecs:
+        if not wire.codec_supported(name):
+            raise ValueError("unsupported wire codec {!r} (supported: {})"
+                             .format(name, wire.supported_codecs()))
+        if name != "none":
+            out.append(name)
+    return out
+
 
 class ServiceFeed(object):
     """Consumer-side client: a ``DataFeed``-compatible feed whose rows come
@@ -1055,12 +1414,17 @@ class ServiceFeed(object):
         frame, any commit (duplicates included), or any ledger movement
         (a co-consumer's commits count); size it above the worst-case
         stream time of a single split.
+      codecs: wire-compression preference list offered at dial (first
+        codec the worker supports wins; raw colv1 when nothing matches).
+        ``None`` resolves from ``TFOS_WIRE_CODEC`` (a codec name, or
+        ``off``/``0``/``pickle`` to offer nothing) and falls back to
+        :func:`wire.supported_codecs`; ``[]`` disables the offer.
     """
 
     def __init__(self, dispatcher_addr, files, job_name="default",
                  mode=SHARD_DYNAMIC, num_epochs=1, consumer_id=None,
                  input_mapping=None, prefetch=2, min_workers=1,
-                 retry_policy=None, timeout=60.0):
+                 retry_policy=None, timeout=60.0, codecs=None):
         if mode not in _MODES:
             raise ValueError("unknown sharding mode {!r} (one of {})"
                              .format(mode, _MODES))
@@ -1077,6 +1441,7 @@ class ServiceFeed(object):
         self.min_workers = min_workers
         self.retry_policy = retry_policy or _default_retry_policy()
         self.timeout = timeout
+        self.codecs = _resolve_codecs(codecs)
         # DataFeed-compatible observability surface
         self.wire_formats = {}
         self.items_consumed = 0
@@ -1085,6 +1450,14 @@ class ServiceFeed(object):
         self.split_dupes = 0
         self.splits_discarded = 0
         self.bytes_received = 0
+        # cache/compression telemetry relayed by workers on split_end
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.cache_evictions = 0
+        self.cache_bytes = 0
+        self.compress_raw_bytes = 0
+        self.compress_wire_bytes = 0
+        self._cache_resident = {}   # worker_id -> latest resident gauge
         self._fault = fault.from_env()
         self._chunks = _queue.Queue(maxsize=max(2, prefetch))
         self._buffer = []
@@ -1306,8 +1679,12 @@ class ServiceFeed(object):
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._stream_lock:
                 self._stream_socks[worker_id] = sock
-            _send_json(sock, {"job": self.job_name,
-                              "consumer": self.consumer_id})
+            hello = {"job": self.job_name, "consumer": self.consumer_id}
+            if self.codecs:
+                # compression offer: the worker answers by tagging columns
+                # with the first codec it supports (raw frames otherwise)
+                hello["codecs"] = list(self.codecs)
+            _send_json(sock, hello)
             self._last_progress = time.monotonic()
             while not self._stop.is_set():
                 kind, payload = _recv_frame(sock)
@@ -1321,6 +1698,7 @@ class ServiceFeed(object):
                         cur = (int(msg["epoch"]), int(msg["split"]))
                         pending = []
                     elif mtype == "split_end":
+                        self._tally_split_end(worker_id, msg)
                         self._commit_split(
                             (int(msg["epoch"]), int(msg["split"])), pending,
                             flow=msg.get("flow"))
@@ -1384,11 +1762,33 @@ class ServiceFeed(object):
                         self._dial_failures.get(worker_id, 0) + 1)
                     self._streams.pop(worker_id, None)
 
+    def _tally_split_end(self, worker_id, msg):
+        """Fold the cache fields a worker rides on ``split_end`` into this
+        feed's counters (tallied before the commit so a dedupe-dropped
+        duplicate still reports the serve it caused upstream)."""
+        verdict = msg.get("cache")
+        if verdict == "hit":
+            self.cache_hits += 1
+        elif verdict == "miss":
+            self.cache_misses += 1
+        self.cache_bytes += int(msg.get("cache_bytes", 0) or 0)
+        self.cache_evictions += int(msg.get("cache_evicted", 0) or 0)
+        if "cache_resident" in msg:
+            self._cache_resident[worker_id] = int(msg["cache_resident"])
+
     def _decode(self, kind, payload):
         if kind == _K_COLV1:
             # zero-copy: the frombuffer views pin `payload`, which is ours
-            chunk = wire.decode_chunk(payload, copy=False)
-            fmt = wire.WIRE_COLV1
+            info = {}
+            chunk = wire.decode_chunk(payload, copy=False, info=info)
+            codecs = info.get("codecs")
+            # per-link codec attribution: compressed frames count under
+            # "colv1+<codec>" so telemetry can split raw from compressed
+            fmt = (wire.WIRE_COLV1 + "+" + "+".join(codecs) if codecs
+                   else wire.WIRE_COLV1)
+            if codecs:
+                self.compress_raw_bytes += int(info.get("raw_bytes", 0))
+                self.compress_wire_bytes += len(payload)
             n = chunk.count
         elif kind == _K_PICKLE:
             rows = pickle.loads(payload)
@@ -1657,4 +2057,19 @@ class ServiceFeed(object):
             pass
         for fmt, n in list(self.wire_formats.items()):
             snap["wire_{}".format(fmt)] = n
+        # worker cache telemetry (relayed on split_end): always present so
+        # dashboards see zeros, not gaps, when the cache is disabled
+        snap["dataservice_cache_hit"] = self.cache_hits
+        snap["dataservice_cache_miss"] = self.cache_misses
+        snap["dataservice_cache_bytes"] = self.cache_bytes
+        snap["dataservice_cache_evictions"] = self.cache_evictions
+        if self._cache_resident:
+            snap["dataservice_cache_resident_max"] = max(
+                self._cache_resident.values())
+        if self.compress_wire_bytes:
+            from . import metrics as _metrics
+            snap["wire_compress_saved_bytes"] = (
+                self.compress_raw_bytes - self.compress_wire_bytes)
+            snap["wire_compress_ratio_max"] = round(_metrics.compression_ratio(
+                self.compress_raw_bytes, self.compress_wire_bytes), 4)
         return snap

@@ -9,7 +9,8 @@ Usage::
 
     python -m tensorflowonspark_tpu.dataservice_worker \\
         --dispatcher HOST:PORT [--reader jsonl|tfrecord] [--host H] \\
-        [--port P] [--worker-id ID] [--heartbeat SECS] [--process-pool]
+        [--port P] [--worker-id ID] [--heartbeat SECS] [--process-pool] \\
+        [--cache-bytes N] [--cache-spill-dir DIR]
 """
 
 import argparse
@@ -37,6 +38,11 @@ def main(argv=None):
                         help="heartbeat interval seconds")
     parser.add_argument("--process-pool", action="store_true",
                         help="read splits with ProcessPoolFeed")
+    parser.add_argument("--cache-bytes", type=int, default=None,
+                        help="chunk-cache byte budget (default: "
+                             "TFOS_DS_CACHE_BYTES env, 0/unset disables)")
+    parser.add_argument("--cache-spill-dir", default=None,
+                        help="spill LRU-evicted cache entries to this dir")
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -59,7 +65,9 @@ def main(argv=None):
         args.dispatcher, row_reader=row_reader, host=args.host,
         port=args.port, worker_id=args.worker_id,
         heartbeat_interval=args.heartbeat,
-        use_process_pool=args.process_pool)
+        use_process_pool=args.process_pool,
+        cache_bytes=args.cache_bytes,
+        cache_spill_dir=args.cache_spill_dir)
     worker.start()
     print("worker {} ready on {}:{}".format(worker.worker_id, worker.host,
                                             worker.port), flush=True)

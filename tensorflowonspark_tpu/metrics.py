@@ -78,6 +78,18 @@ def mfu_from_step_time(step_flops, step_seconds):
     return step_flops / peak / step_seconds
 
 
+def compression_ratio(raw_bytes, wire_bytes):
+    """Wire compression ratio ``raw / wire`` (> 1 when the codec saved
+    bytes; 1.0 when nothing compressed or either side is unknown, so
+    gauges and bench stats never divide by zero).  The one definition
+    shared by ``ServiceFeed.counters_snapshot``, the bench
+    ``dataservice_cached_epoch`` leg, and ``profile_feed.py`` — the same
+    single-formula contract as :func:`mfu_from_step_time`."""
+    if not raw_bytes or not wire_bytes or wire_bytes <= 0:
+        return 1.0
+    return raw_bytes / float(wire_bytes)
+
+
 def peak_flops_per_device():
     import jax
 

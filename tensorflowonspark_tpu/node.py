@@ -344,6 +344,10 @@ class TPUNodeContext(object):
             dispatcher = self.data_service["dispatcher"]
         kwargs.setdefault("consumer_id",
                           "executor-{}".format(self.executor_id))
+        if self.data_service and self.data_service.get("codecs") is not None:
+            # cluster-pinned wire-compression offer (cluster.run data_service
+            # spec); an explicit codecs= kwarg still wins
+            kwargs.setdefault("codecs", self.data_service["codecs"])
         feed = dataservice.ServiceFeed(dispatcher, files, **kwargs)
         # same lifecycle wiring as get_data_feed: preemption drain stops the
         # network streams, and the feed's dataservice_* counters ride this

@@ -98,6 +98,12 @@ DEFAULT_CONFIG = {
     # data service: instantaneous prefetch-queue fill percentage at/above
     # this means the consumer is the bottleneck (producer pinned at cap)
     "queue_sat_pct": 95.0,
+    # cache thrash: a window must evict at least this many entries, AND
+    # evictions must reach this multiple of the window's cache hits, before
+    # the worker chunk cache is declared thrashing (budget too small for
+    # the working set — every insert evicts the entry the next split needs)
+    "cache_thrash_min_evictions": 8,
+    "cache_thrash_evict_hit_ratio": 1.0,
     # heartbeat-miss streak: newest sample older than interval * this
     # fires BEFORE the liveness fence (which waits heartbeat_misses beats)
     "heartbeat_miss_beats": 2.0,
@@ -230,6 +236,7 @@ class RuleEngine(object):
             ("mfu_collapse", self._rule_mfu_collapse),
             ("infeed_starved", self._rule_infeed_starved),
             ("dataservice_saturation", self._rule_dataservice_saturation),
+            ("cache_thrash", self._rule_cache_thrash),
             ("heartbeat_miss", self._rule_heartbeat_miss),
         )
 
@@ -459,6 +466,38 @@ class RuleEngine(object):
                     threshold=cfg["queue_sat_pct"],
                     message="executor {} data-service prefetch queue at "
                             "{:.0f}% fill".format(node, sat)))
+        return alerts
+
+    def _rule_cache_thrash(self, window, now):
+        """Alert on a sustained eviction-dominated window of the worker
+        chunk cache (``dataservice_cache_evictions`` vs ``_hit`` deltas):
+        the byte budget is smaller than the epoch working set, so entries
+        are evicted before their epoch-2 replay — all of the cache's memory
+        cost, none of its hit rate.  The fix is a bigger ``cache_bytes``
+        (or disk spill), not more workers."""
+        cfg = self.config
+        alerts = []
+        for node, samples in window.items():
+            if len(samples) < cfg["min_samples"]:
+                continue
+            d = window_deltas(samples)
+            if d is None:
+                continue
+            evictions = d["deltas"].get("dataservice_cache_evictions", 0)
+            hits = d["deltas"].get("dataservice_cache_hit", 0)
+            if evictions < cfg["cache_thrash_min_evictions"]:
+                continue
+            ratio = evictions / max(float(hits), 1.0)
+            if ratio >= cfg["cache_thrash_evict_hit_ratio"]:
+                alerts.append(self._alert(
+                    "cache_thrash", now, executor=node, severity="warn",
+                    value=round(ratio, 3),
+                    threshold=cfg["cache_thrash_evict_hit_ratio"],
+                    evictions=evictions, hits=hits,
+                    message="executor {} chunk cache thrashing: {} "
+                            "evictions vs {} hits in {:.0f}s — raise "
+                            "cache_bytes / TFOS_DS_CACHE_BYTES".format(
+                                node, evictions, hits, d["span_secs"])))
         return alerts
 
     def _rule_heartbeat_miss(self, window, now):

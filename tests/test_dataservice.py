@@ -482,7 +482,10 @@ def test_colv1_transport_parity_with_local_filefeed(tmp_path):
         try:
             got = _drain(feed)
             assert sorted(got) == sorted(expected)
-            assert feed.wire_formats.get(wire.WIRE_COLV1, 0) > 0
+            # compressed streams count under "colv1+<codec>" — any colv1-
+            # prefixed key proves the framed transport carried the rows
+            assert sum(n for fmt, n in feed.wire_formats.items()
+                       if fmt.startswith(wire.WIRE_COLV1)) > 0
             assert wire.WIRE_PICKLE not in feed.wire_formats
         finally:
             feed.terminate()
@@ -678,3 +681,224 @@ def test_assemble_columns_module_function():
     np.testing.assert_array_equal(named["x"], np.arange(5))
     with pytest.raises(ValueError, match="fields"):
         assemble_columns(parts, True, None, ["only_one"])
+
+
+# ---------------------------------------------------------------------------
+# Data-plane v2: worker chunk cache + negotiated wire compression
+# ---------------------------------------------------------------------------
+
+def _payload_row(i):
+    """(id, 64-float payload) rows: wide enough for colv1 framing AND for
+    the zlib pay-off check to keep the payload column compressed."""
+    return [i, [float(i % 7)] * 64]
+
+
+def _drain_ids(feed, batch_size=64, timeout=30.0):
+    """The id column out of a feed of ``_payload_row`` tuples."""
+    got = []
+    deadline = time.monotonic() + timeout
+    while not feed.should_stop():
+        assert time.monotonic() < deadline, "feed did not complete"
+        arrays, count = feed.next_batch_arrays(batch_size)
+        if count:
+            got.extend(int(x) for x in arrays[0])
+    return got
+
+
+def _frames(nbytes, items=10, kind=1):
+    return [(kind, b"\x5a" * nbytes, items)]
+
+
+def test_frame_cache_hit_then_stale_source_invalidates(tmp_path):
+    from tensorflowonspark_tpu.dataservice import _FrameCache
+
+    path = str(tmp_path / "src.jsonl")
+    with open(path, "w") as f:
+        f.write("old\n")
+    cache = _FrameCache(max_bytes=1 << 20)
+    sig = _FrameCache.signature(path)
+    assert cache.lookup(path, "zlib") is None and cache.misses == 1
+    cache.put(path, "zlib", sig, _frames(100))
+    assert cache.lookup(path, "zlib") == _frames(100) and cache.hits == 1
+    # the codec is part of the key: a raw-link serve never sees zlib frames
+    assert cache.lookup(path, None) is None
+    # touch/resize the source between serves: the entry must drop
+    time.sleep(0.01)
+    with open(path, "w") as f:
+        f.write("newer and longer\n")
+    assert cache.lookup(path, "zlib") is None
+    assert cache.invalidations == 1
+    assert cache.resident_bytes() == 0
+
+
+def test_frame_cache_lru_eviction_and_uncacheable(tmp_path):
+    from tensorflowonspark_tpu.dataservice import _FrameCache
+
+    cache = _FrameCache(max_bytes=250)
+    cache.put("a", None, None, _frames(100))
+    cache.put("b", None, None, _frames(100))
+    assert cache.lookup("a", None) is not None  # refresh a's LRU slot
+    assert cache.put("c", None, None, _frames(100)) == 1  # b (LRU) evicted
+    assert cache.lookup("b", None) is None and cache.evictions == 1
+    assert cache.lookup("a", None) is not None
+    assert cache.lookup("c", None) is not None
+    # an entry over the whole budget is never admitted (and evicts nothing)
+    assert cache.put("big", None, None, _frames(300)) == 0
+    assert cache.uncacheable == 1 and cache.lookup("big", None) is None
+
+
+def test_frame_cache_spills_to_disk_and_promotes_back(tmp_path):
+    from tensorflowonspark_tpu.dataservice import _FrameCache
+
+    cache = _FrameCache(max_bytes=150, spill_dir=str(tmp_path / "spill"))
+    frames_a = [(1, b"\x11" * 60, 5), (2, b"\x22" * 40, 7)]
+    cache.put("a", "zlib", None, frames_a)
+    cache.put("b", "zlib", None, _frames(100))
+    assert cache.evictions == 1 and cache.spills == 1  # a hit the disk
+    # a spilled hit reads the exact frame sequence back and re-residents it
+    # (which pushes b over the budget in turn: b evicts and spills)
+    assert cache.lookup("a", "zlib") == frames_a
+    assert cache.spill_hits == 1
+    assert cache.evictions == 2 and cache.spills == 2
+    assert cache.lookup("b", "zlib") is not None  # b promotes back too
+    assert cache.spill_hits == 2
+    flat = cache.counters_flat()
+    assert flat["dataservice_cache_spills"] == cache.spills
+    assert flat["dataservice_cache_spill_hits"] == cache.spill_hits
+
+
+def test_epoch2_serves_from_worker_cache_with_compression(tmp_path):
+    """The tentpole end to end on one worker: epoch 1 cold-serves and
+    fills the cache, epoch 2 replays every split from it; the negotiated
+    zlib codec engages on the link and every counter reaches the
+    consumer's snapshot."""
+    splits, rows = _write_jsonl(tmp_path, 6, 30, row_fn=_payload_row)
+    disp = DispatcherServer(heartbeat_interval=0.2, heartbeat_misses=2,
+                            host="127.0.0.1")
+    addr = disp.start()
+    w = FeedWorker(addr, row_reader=data.jsonl_rows, worker_id="cw0",
+                   heartbeat_interval=0.2, cache_bytes=32 << 20).start()
+    try:
+        feed = ServiceFeed(addr, splits, job_name="cached",
+                           mode=SHARD_STATIC, num_epochs=2, timeout=30.0)
+        got = _drain_ids(feed, timeout=40.0)
+        assert sorted(got) == sorted([r[0] for r in rows] * 2)
+        assert w.chunk_cache.hits == len(splits)
+        assert w.chunk_cache.misses == len(splits)
+        assert feed.cache_hits == len(splits)
+        assert feed.cache_misses == len(splits)
+        snap = feed.counters_snapshot()
+        assert snap["dataservice_cache_hit"] == len(splits)
+        assert snap["dataservice_cache_bytes"] > 0
+        assert snap["dataservice_cache_resident_max"] > 0
+        assert snap["dataservice_split_dupes"] == 0
+        # compressed colv1 frames on the link, visible as a ratio gauge
+        assert sum(n for fmt, n in feed.wire_formats.items()
+                   if fmt.startswith("colv1+")) > 0
+        assert snap["wire_compress_ratio_max"] > 1.0
+        assert snap["wire_compress_saved_bytes"] > 0
+        feed.terminate()
+    finally:
+        w.stop()
+        disp.stop()
+
+
+def test_cache_invalidates_when_source_file_changes(tmp_path):
+    """Freshness: a source file rewritten between jobs must not replay
+    stale frames — the worker re-reads it and the consumer sees the new
+    content (entries are shared across jobs over the same files)."""
+    splits, rows = _write_jsonl(tmp_path, 4, 20, row_fn=_payload_row)
+    disp = DispatcherServer(heartbeat_interval=0.2, heartbeat_misses=2,
+                            host="127.0.0.1")
+    addr = disp.start()
+    w = FeedWorker(addr, row_reader=data.jsonl_rows, worker_id="iw0",
+                   heartbeat_interval=0.2, cache_bytes=32 << 20).start()
+    try:
+        feed_a = ServiceFeed(addr, splits, job_name="fresh-a",
+                             mode=SHARD_STATIC, timeout=30.0)
+        assert sorted(_drain_ids(feed_a)) == sorted(r[0] for r in rows)
+        feed_a.terminate()
+        assert w.chunk_cache.misses == len(splits)
+
+        # rewrite split 0 with different ids and a different byte size
+        time.sleep(0.01)
+        with open(splits[0], "w") as f:
+            for i in range(1000, 1025):
+                f.write(json.dumps(_payload_row(i)) + "\n")
+        expect_b = [r[0] for r in rows if r[0] >= 20] + list(range(1000, 1025))
+
+        feed_b = ServiceFeed(addr, splits, job_name="fresh-b",
+                             mode=SHARD_STATIC, timeout=30.0)
+        assert sorted(_drain_ids(feed_b)) == sorted(expect_b)
+        # splits 1-3 replayed from the first job's entries; split 0 dropped
+        assert w.chunk_cache.invalidations == 1
+        assert w.chunk_cache.hits == len(splits) - 1
+        assert feed_b.cache_hits == len(splits) - 1
+        assert feed_b.cache_misses == 1
+        feed_b.terminate()
+    finally:
+        w.stop()
+        disp.stop()
+
+
+@pytest.mark.chaos(timeout=60)
+def test_worker_killed_mid_cached_epoch_exactly_once(tmp_path):
+    """The exactly-once ledger with the cache armed: a worker crashes
+    while replaying epoch 2 from its cache; STATIC ownership re-pins its
+    splits to the survivor, which cold-serves them; the consumer still
+    sees every element exactly twice — the cache must not relax the
+    split_begin/split_end/abort protocol."""
+    splits, rows = _write_jsonl(tmp_path, 10, 40, row_fn=_payload_row)
+    disp = DispatcherServer(heartbeat_interval=0.2, heartbeat_misses=2,
+                            host="127.0.0.1")
+    addr = disp.start()
+    workers = [FeedWorker(addr, row_reader=data.jsonl_rows,
+                          worker_id="kw{}".format(i), heartbeat_interval=0.2,
+                          cache_bytes=32 << 20).start() for i in range(2)]
+    try:
+        feed = ServiceFeed(addr, splits, job_name="cache-kill",
+                           mode=SHARD_STATIC, num_epochs=2, timeout=30.0)
+
+        def killer():
+            deadline = time.monotonic() + 20
+            # wait until epoch 2 is being replayed from the cache
+            while (workers[0].chunk_cache.hits < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+            workers[0].stop(abrupt=True)  # crash: no BYE, beats stop
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        try:
+            got = _drain_ids(feed, timeout=40.0)
+            kt.join(timeout=10)
+            assert sorted(got) == sorted([r[0] for r in rows] * 2)
+            status = DispatcherClient(addr).status("cache-kill")
+            assert status["done"]
+            snap = feed.counters_snapshot()
+            assert snap["dataservice_split_dupes"] == 0
+        finally:
+            feed.terminate()
+    finally:
+        for w in workers:
+            w.stop()
+        disp.stop()
+
+
+def test_wire_codec_env_knob_and_explicit_list(tmp_path, monkeypatch):
+    """TFOS_WIRE_CODEC=off forces raw colv1 frames end to end (the A/B
+    parity knob); an unsupported explicit ``codecs=`` list raises."""
+    splits, rows = _write_jsonl(tmp_path, 3, 20, row_fn=_payload_row)
+    monkeypatch.setenv("TFOS_WIRE_CODEC", "off")
+    with _Service(n_workers=1) as svc:
+        feed = ServiceFeed(svc.addr, splits, job_name="rawlink",
+                           mode=SHARD_DYNAMIC, timeout=30.0)
+        assert feed.codecs == []
+        assert sorted(_drain_ids(feed)) == sorted(r[0] for r in rows)
+        assert set(feed.wire_formats) == {wire.WIRE_COLV1}
+        snap = feed.counters_snapshot()
+        assert "wire_compress_ratio_max" not in snap
+        feed.terminate()
+    with pytest.raises(ValueError, match="unsupported wire codec"):
+        ServiceFeed(("127.0.0.1", 1), splits, job_name="bad",
+                    codecs=["snappy"])

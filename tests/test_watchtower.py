@@ -149,6 +149,38 @@ class TestRuleEngine:
         assert [(a["rule"], a["executor"]) for a in alerts] == \
             [("dataservice_saturation", "0")]
 
+    def test_cache_thrash_fires_on_eviction_dominated_window(self):
+        """An eviction-dominated chunk-cache window (budget smaller than
+        the epoch working set) names the thrashing executor and the knob
+        to turn; a hit-dominated peer stays silent."""
+        eng = watchtower.RuleEngine()
+        thrash = [(T0 + i, {"dataservice_cache_evictions": i * 5,
+                            "dataservice_cache_hit": i})
+                  for i in range(1, 7)]
+        healthy = [(T0 + i, {"dataservice_cache_evictions": 0,
+                             "dataservice_cache_hit": i * 10})
+                   for i in range(1, 7)]
+        alerts = eng.evaluate({"0": thrash, "1": healthy}, now=T0 + 6)
+        assert [(a["rule"], a["executor"]) for a in alerts] == \
+            [("cache_thrash", "0")]
+        a = alerts[0]
+        assert a["evictions"] == 25 and a["hits"] == 5
+        assert a["value"] >= eng.config["cache_thrash_evict_hit_ratio"]
+        assert "cache_bytes" in a["message"]
+        # a cache-less window (no counters at all) never trips the rule
+        assert eng.evaluate({"0": _beats(6)}, now=T0 + 6) == []
+
+    def test_cache_thrash_config_overrides(self):
+        """The two knobs are real config keys: a raised eviction floor
+        silences the same window, and typos still fail fast."""
+        eng = watchtower.RuleEngine({"cache_thrash_min_evictions": 100})
+        thrash = [(T0 + i, {"dataservice_cache_evictions": i * 5,
+                            "dataservice_cache_hit": i})
+                  for i in range(1, 7)]
+        assert eng.evaluate({"0": thrash}, now=T0 + 6) == []
+        with pytest.raises(ValueError, match="cache_thrash_min_evict"):
+            watchtower.RuleEngine({"cache_thrash_min_evict": 8})
+
     def test_unknown_config_key_raises(self):
         with pytest.raises(ValueError, match="straggler_zz"):
             watchtower.RuleEngine({"straggler_zz": 4.0})

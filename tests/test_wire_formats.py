@@ -136,6 +136,166 @@ def test_decode_rejects_truncated_and_corrupt_frames():
                                   np.arange(6, dtype=np.int32).reshape(2, 3))
 
 
+# ---------------------------------------------------------------------------
+# per-column wire compression (negotiated codecs)
+# ---------------------------------------------------------------------------
+
+# every codec this host can encode, plus explicit levels — lz4 joins the
+# matrix automatically where the package is importable
+CODECS = ["none", "zlib", "zlib-0", "zlib-9"]
+if wire.codec_supported("lz4"):
+    CODECS.append("lz4")
+
+
+def _compressible(dtype, rows=64):
+    """Tiled (compressible) 2-D column + 1-D column of ``dtype`` big enough
+    to clear the codec's minimum-size gate."""
+    base = np.arange(16).reshape(1, 16) % 7
+    a = np.ascontiguousarray(np.tile(base, (rows, 4)).astype(dtype))
+    b = np.ascontiguousarray((np.arange(rows * 128) % 5).astype(dtype))
+    return a, b
+
+
+@pytest.mark.parametrize("codec", CODECS)
+@pytest.mark.parametrize("dtype", NUMERIC_DTYPES,
+                         ids=[np.dtype(d).name for d in NUMERIC_DTYPES])
+def test_codec_roundtrip_matrix(codec, dtype):
+    a, b = _compressible(dtype)
+    stats, info = {}, {}
+    buf = wire.frame_bytes((a, b), len(a), True, codec=codec, stats=stats)
+    cols, count, tuple_rows = wire.decode(buf, info=info)
+    assert count == len(a) and tuple_rows
+    np.testing.assert_array_equal(cols[0], a)
+    np.testing.assert_array_equal(cols[1], b)
+    assert cols[0].dtype == a.dtype and cols[1].dtype == b.dtype
+    if codec in ("none", "zlib-0"):
+        # zlib level 0 stores without compressing, so the pay-off check
+        # keeps every column raw — bit-identical to the uncompressed frame
+        assert buf == wire.frame_bytes((a, b), len(a), True)
+        assert info["codecs"] == []
+    else:
+        assert stats["cols_compressed"] == 2
+        assert stats["wire_bytes"] < stats["raw_bytes"]
+        assert info["codecs"] == [codec.split("-")[0]]
+        assert info["raw_bytes"] == len(wire.frame_bytes((a, b), len(a),
+                                                         True))
+
+
+@pytest.mark.parametrize("codec", [c for c in CODECS if c != "none"])
+def test_codec_roundtrip_bf16_as_uint16(codec):
+    # the bf16 carrier convention survives compression: uint16 bit patterns
+    # round-trip bit-exactly through the codec
+    bits = np.ascontiguousarray(
+        np.tile(np.array([0x3F80, 0x4000, 0xC0A0, 0x0000, 0x7F80],
+                         np.uint16), (64, 2)))
+    buf = wire.frame_bytes((bits,), len(bits), False, codec=codec)
+    cols, count, _ = wire.decode(buf)
+    assert cols[0].dtype == np.uint16
+    np.testing.assert_array_equal(cols[0], bits)
+
+
+@pytest.mark.parametrize("codec", CODECS)
+def test_codec_empty_and_zero_dim_columns(codec):
+    scalar = np.array(3.5, np.float32)
+    empty = np.empty((0, 7), np.int64)
+    buf = wire.frame_bytes((scalar, empty), 0, True, codec=codec)
+    cols, count, _ = wire.decode(buf)
+    assert cols[0].shape == () and cols[0] == np.float32(3.5)
+    assert cols[1].shape == (0, 7) and cols[1].dtype == np.int64
+
+
+def test_incompressible_columns_stay_raw():
+    # random mantissas don't compress: the sampled pay-off check must leave
+    # the column raw and the frame identical to an uncompressed one
+    rng = np.random.default_rng(3)
+    col = rng.random((256, 64))
+    stats = {}
+    buf = wire.frame_bytes((col,), 256, False, codec="zlib", stats=stats)
+    assert stats["cols_compressed"] == 0 and stats["cols_raw"] == 1
+    assert buf == wire.frame_bytes((col,), 256, False)
+    cols, _, _ = wire.decode(buf)
+    np.testing.assert_array_equal(cols[0], col)
+
+
+def test_small_columns_skip_codec_framing():
+    # columns under the minimum-size gate never pay for codec overhead
+    tiny = np.zeros((4, 4), np.float32)   # 64 bytes, trivially compressible
+    buf = wire.frame_bytes((tiny,), 4, False, codec="zlib")
+    assert buf == wire.frame_bytes((tiny,), 4, False)
+
+
+def test_decode_rejects_unknown_codec_tag():
+    a, _ = _compressible(np.float32)
+    buf = bytearray(wire.frame_bytes((a,), len(a), False, codec="zlib"))
+    desc_off = wire._FIXED.size
+    dstr, ndim, tag, off, nbytes = wire._DESC.unpack_from(buf, desc_off)
+    assert tag == wire._CODEC_ZLIB
+    wire._DESC.pack_into(buf, desc_off, dstr, ndim, 9, off, nbytes)
+    with pytest.raises(wire.FrameError, match="unknown codec tag 9"):
+        wire.decode(bytes(buf))
+
+
+@pytest.mark.skipif(wire.codec_supported("lz4"),
+                    reason="lz4 importable here: the unavailable-codec "
+                           "error path can't trigger")
+def test_decode_names_unavailable_codec():
+    a, _ = _compressible(np.float32)
+    buf = bytearray(wire.frame_bytes((a,), len(a), False, codec="zlib"))
+    desc_off = wire._FIXED.size
+    dstr, ndim, tag, off, nbytes = wire._DESC.unpack_from(buf, desc_off)
+    wire._DESC.pack_into(buf, desc_off, dstr, ndim, wire._CODEC_LZ4, off,
+                         nbytes)
+    with pytest.raises(wire.FrameError,
+                       match="codec lz4.*not.*available on this host"):
+        wire.decode(bytes(buf))
+
+
+def test_decode_rejects_corrupt_compressed_body():
+    a, _ = _compressible(np.float32)
+    buf = bytearray(wire.frame_bytes((a,), len(a), False, codec="zlib"))
+    # trash the compressed body (past the header) — must surface as a
+    # FrameError naming the codec, not a bare zlib.error
+    desc_off = wire._FIXED.size
+    _, _, _, off, nbytes = wire._DESC.unpack_from(buf, desc_off)
+    for i in range(off + 2, min(off + 34, off + nbytes)):
+        buf[i] ^= 0xFF
+    with pytest.raises(wire.FrameError, match="codec zlib"):
+        wire.decode(bytes(buf))
+
+
+def test_frame_bytes_rejects_unknown_codec_name():
+    a, _ = _compressible(np.float32)
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        wire.frame_bytes((a,), len(a), False, codec="snappy")
+    with pytest.raises(ValueError, match="zlib level"):
+        wire.frame_bytes((a,), len(a), False, codec="zlib-11")
+
+
+def test_codec_negotiation_prefers_consumer_order():
+    assert "zlib" in wire.supported_codecs()
+    assert wire.supported_codecs()[-1] == "none"
+    assert wire.negotiate_codec(["zlib-9", "zlib"]) == "zlib-9"
+    assert wire.negotiate_codec(["snappy", "zlib"]) == "zlib"
+    assert wire.negotiate_codec(["snappy"]) is None
+    assert wire.negotiate_codec(["none"]) is None     # raw is "no codec"
+    assert wire.negotiate_codec(None) is None         # legacy hello
+    if not wire.codec_supported("lz4"):
+        assert wire.negotiate_codec(["lz4", "zlib"]) == "zlib"
+
+
+def test_compressed_frame_decode_info_and_views():
+    a, b = _compressible(np.int64)
+    buf = wire.frame_bytes((a, b), len(a), True, codec="zlib")
+    info = {}
+    # copy=False on a compressed frame: columns come from the private
+    # decompression buffer, never views into `buf`
+    cols, _, _ = wire.decode(buf, copy=False, info=info)
+    backing = np.frombuffer(buf, np.uint8)
+    assert not np.shares_memory(cols[0], backing)
+    np.testing.assert_array_equal(cols[0], a)
+    assert info["cols_compressed"] == 2
+
+
 def test_decode_copy_false_returns_views_copy_true_owns():
     col = np.arange(12, dtype=np.float64).reshape(3, 4)
     buf = wire.frame_bytes((col,), 3, False)

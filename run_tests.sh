@@ -46,7 +46,12 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # the on-disk journal after the cluster is gone, and prove the caching
 # tier pays: 2 cache-armed worker subprocesses serving a 2-epoch job with
 # >=90% epoch-2 cache hits, compressed colv1 frames, and a nonzero
-# wire-compression ratio on a live /metrics scrape
+# wire-compression ratio on a live /metrics scrape, and prove the serving
+# gateway survives chaos: 2 replica subprocesses under concurrent client
+# load, the pinned replica SIGKILLed mid-run and fenced by heartbeat
+# timeout, zero accepted requests lost across the failover, and the
+# serving telemetry (nonzero tfos_serving_p99_us / tfos_serving_batch_fill
+# plus a live latency_slo_burn alert) on /metrics and /alerts
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -56,5 +61,6 @@ python scripts/ci_assert_overlap.py
 python scripts/ci_assert_observatory.py
 python scripts/ci_assert_profiling.py
 python scripts/ci_assert_watchtower.py
+python scripts/ci_assert_serving.py
 
 exit $rc

@@ -299,6 +299,10 @@ HEADLINE_METRICS = (
     ("dataservice_epoch2_items_per_sec", "dataservice_cached_epoch",
      "higher"),
     ("wire_compress_ratio", "dataservice_cached_epoch", "higher"),
+    # serving gateway (absent pre-round-11, skipped by run_diff)
+    ("serving_saturation_qps", "serving_latency", "higher"),
+    ("serving_batch_speedup", "serving_latency", "higher"),
+    ("serving_p99_us", "serving_latency", "lower"),
 )
 
 

@@ -69,7 +69,7 @@ import time
 
 import numpy as np
 
-from tensorflowonspark_tpu import fault, marker, telemetry, wire
+from tensorflowonspark_tpu import fault, marker, telemetry, transport, wire
 from tensorflowonspark_tpu.reservation import (
     Client, HeartbeatSender, MessageSocket)
 
@@ -96,11 +96,13 @@ SHARD_DYNAMIC = "dynamic"
 
 _MODES = (SHARD_OFF, SHARD_STATIC, SHARD_DYNAMIC)
 
-# Data-stream framing: 4-byte big-endian payload length + 1-byte kind.
-_DHEADER = struct.Struct(">IB")
-_K_JSON = 0     # UTF-8 JSON control message
-_K_COLV1 = 1    # one wire.py colv1 frame (zero-copy decode on receipt)
-_K_PICKLE = 2   # pickled row list (object/ragged fallback)
+# Data-stream framing lives in transport.py now (shared with the serving
+# gateway); the underscore aliases keep every internal call site and the
+# tests that poke them unchanged.
+_DHEADER = transport.DHEADER
+_K_JSON = transport.K_JSON       # UTF-8 JSON control message
+_K_COLV1 = transport.K_COLV1     # one wire.py colv1 frame (zero-copy decode)
+_K_PICKLE = transport.K_PICKLE   # pickled row list (object/ragged fallback)
 
 _SENTINEL = object()     # internal end-of-feed marker on the chunk queue
 _INTERRUPTED = object()  # internal next_batch abort marker
@@ -117,57 +119,15 @@ class DispatchError(RuntimeError):
 
 
 # ---------------------------------------------------------------------------
-# Data-stream framing helpers
+# Data-stream framing helpers (extracted to transport.py, re-exported here)
 # ---------------------------------------------------------------------------
 
-def _recv_exact(sock, n):
-    # Returns a bytearray, not bytes: a final bytes(buf) copy of every
-    # ~800 KB chunk payload caps the consumer's aggregate ingest around
-    # 750 MB/s on loopback; skipping it nearly triples the framing ceiling.
-    # Callers treat the buffer as immutable (frombuffer views pin it).
-    buf = bytearray(n)
-    view = memoryview(buf)
-    got = 0
-    while got < n:
-        k = sock.recv_into(view[got:], n - got)
-        if k == 0:
-            raise EOFError("connection closed mid-frame")
-        got += k
-    return buf
-
-
-def _recv_frame(sock):
-    """One ``(kind, payload)`` data frame; raises EOFError on a closed peer."""
-    length, kind = _DHEADER.unpack(_recv_exact(sock, _DHEADER.size))
-    return kind, _recv_exact(sock, length)
-
-
-# Below this, header+payload are sent as one concatenated buffer so small
-# control frames never sit behind Nagle/delayed-ACK interactions with a
-# previous partial segment; at or above it the payload copy costs more than
-# the second sendall (TCP_NODELAY is set on every data socket anyway).
-_SEND_COPY_MAX = 64 * 1024
-
-
-def _send_frame(sock, kind, payload):
-    header = _DHEADER.pack(len(payload), kind)
-    if len(payload) < _SEND_COPY_MAX:
-        sock.sendall(header + payload)
-    else:
-        sock.sendall(header)
-        sock.sendall(payload)
-
-
-def _send_json(sock, obj):
-    _send_frame(sock, _K_JSON, json.dumps(obj).encode("utf-8"))
-
-
-def _addr_tuple(addr):
-    """Normalize ``(host, port)`` / ``[host, port]`` / ``"host:port"``."""
-    if isinstance(addr, str):
-        host, _, port = addr.rpartition(":")
-        return (host, int(port))
-    return (addr[0], int(addr[1]))
+_SEND_COPY_MAX = transport.SEND_COPY_MAX
+_recv_exact = transport.recv_exact
+_recv_frame = transport.recv_frame
+_send_frame = transport.send_frame
+_send_json = transport.send_json
+_addr_tuple = transport.addr_tuple
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,611 @@
+"""Online inference gateway: continuous batching over warm bucket shapes.
+
+The serving tier the ROADMAP's Open item 1 asks for, built from parts the
+training side already proved:
+
+* **continuous/dynamic batcher** — concurrent requests coalesce into one
+  padded-bucket batch under a latency budget (``max_batch`` rows or
+  ``max_wait_ms`` since the oldest queued request, whichever first).  The
+  batch pads up to the :func:`serving.bucket_ladder` rung, and
+  :meth:`ModelServer.warmup` AOT-compiles every rung at load time, so no
+  request ever pays a compile (``serving_compiles`` stays flat under load
+  — the ``train_compile_us`` convention).
+* **admission control** — a *bounded* queue.  At ``max_queue`` pending
+  requests new arrivals are shed immediately with a typed
+  :class:`OverloadError` (code ``overload``); a request whose deadline
+  expires while queued is shed before dispatch (code ``deadline``).
+  Backpressure is an error the client can act on, never an unbounded
+  queue.
+* **shared transport** — request/response batches ride the same
+  length-prefixed colv1 frames as training chunks
+  (:mod:`tensorflowonspark_tpu.transport`), codec negotiation included.
+* **replica failover for free** — each gateway registers in the
+  reservation roster (``job_name="serving"``) and beats its serving
+  counters over the heartbeat channel.  A killed replica is fenced by the
+  PR 3 liveness monitor exactly like a dead trainer; the HA
+  :class:`ServingClient` retries in-flight requests on a surviving
+  replica.
+
+Wire protocol (after the transport hello/hello_ok codec handshake, which
+also advertises ``max_batch`` and the bucket ladder)::
+
+    -> {"type": "predict", "id": n, "count": C, "tensors": [names...],
+        "deadline_ms": optional budget}
+    -> one colv1/pickle frame: the input columns in ``tensors`` order
+    <- {"type": "result", "id": n, "count": C, "outputs": [names...]}
+    <- one colv1/pickle frame: the output columns in ``outputs`` order
+  or
+    <- {"type": "error", "id": n, "code": "overload"|"deadline"|...,
+        "message": str}
+
+Metrics exported per beat (observatory renders ``_hwm``/``_max`` keys as
+gauges, everything else as ``_total`` counters): ``serving_requests``,
+``serving_rows``, ``serving_batches``, ``serving_shed``,
+``serving_compiles``, ``serving_p50_us_max``, ``serving_p99_us_max``,
+``serving_queue_depth_hwm``, ``serving_batch_fill_pct_max``.
+"""
+
+import collections
+import logging
+import socket
+import threading
+import time
+
+import numpy as np
+
+from tensorflowonspark_tpu import transport
+from tensorflowonspark_tpu.transport import Transport, TransportError
+
+logger = logging.getLogger(__name__)
+
+#: Latency samples kept for the p50/p99 window (enough for several beat
+#: intervals at saturation without unbounded growth).
+_LAT_WINDOW = 4096
+
+
+class OverloadError(RuntimeError):
+    """A request was shed by admission control.
+
+    ``code`` says why: ``"overload"`` (bounded queue full on arrival),
+    ``"deadline"`` (the request's budget expired before dispatch), or
+    ``"shutdown"`` (the gateway is draining).  Typed so clients can back
+    off / retry elsewhere instead of pattern-matching strings.
+    """
+
+    def __init__(self, code, message):
+        super(OverloadError, self).__init__(message)
+        self.code = code
+
+
+class _Request(object):
+    """One queued prediction: feed columns plus completion callbacks."""
+
+    __slots__ = ("feed", "count", "deadline", "arrival",
+                 "on_result", "on_error")
+
+    def __init__(self, feed, count, deadline, on_result, on_error):
+        self.feed = feed
+        self.count = count
+        self.deadline = deadline          # monotonic seconds, or None
+        self.arrival = time.monotonic()
+        self.on_result = on_result        # fn(outputs: {name: rows-slice})
+        self.on_error = on_error          # fn(code, message)
+
+
+class GatewayServer(object):
+    """One serving replica: TCP front, continuous batcher, roster member.
+
+    ``server`` is a loaded :class:`serving.ModelServer`; the gateway
+    dispatches coalesced batches through ``server.predict_feed`` so padding
+    and bucket reuse live in exactly one place.  Pass ``roster_addr`` (the
+    reservation server) to join a replica fleet — registration metadata
+    carries this gateway's ``host:port`` so clients can discover it, and
+    heartbeats carry the serving counters into the observatory.
+    """
+
+    def __init__(self, server, host="127.0.0.1", port=0, max_batch=None,
+                 max_wait_ms=5.0, max_queue=None, roster_addr=None,
+                 replica_id=None, task_index=0, heartbeat_interval=1.0,
+                 warmup=True):
+        self.server = server
+        self.host = host
+        self.port = port
+        self.max_batch = min(max_batch or server.batch_size,
+                             server.batch_size)
+        self.max_wait = max_wait_ms / 1000.0
+        # 4 batches of headroom by default: deep enough to ride a dispatch,
+        # shallow enough that shed latency stays bounded by ~4 batch times.
+        self.max_queue = max_queue or 4 * self.max_batch
+        self.roster_addr = roster_addr
+        self.replica_id = replica_id or "serving-{}".format(task_index)
+        self.task_index = task_index
+        self.heartbeat_interval = heartbeat_interval
+        self._warmup = warmup
+
+        self._queue = collections.deque()
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._listener = None
+        self._threads = []
+        self._conns = set()
+        self._hb = None
+
+        # counters (cumulative; heartbeat latch is latest-value-per-key)
+        self.requests_total = 0
+        self.rows_total = 0
+        self.batches_total = 0
+        self.shed_total = 0
+        self._lat_us = collections.deque(maxlen=_LAT_WINDOW)
+        self._queue_depth_hwm = 0
+        self._batch_fill_pct = 0.0
+        self._metrics_lock = threading.Lock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        """Warm the bucket ladder, bind, start batcher/acceptor threads,
+        and (with ``roster_addr``) register + beat.  Returns
+        ``(host, port)``."""
+        if self._warmup:
+            warmed = self.server.warmup()
+            logger.info("gateway %s: %d bucket(s) warm (ladder %s)",
+                        self.replica_id, warmed, self.server.buckets)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((self.host, self.port))
+        self._listener.listen(128)
+        self.port = self._listener.getsockname()[1]
+
+        batcher = threading.Thread(target=self._batch_loop,
+                                   name="gateway-batcher", daemon=True)
+        acceptor = threading.Thread(target=self._accept_loop,
+                                    name="gateway-accept", daemon=True)
+        self._threads = [batcher, acceptor]
+        batcher.start()
+        acceptor.start()
+
+        if self.roster_addr:
+            from tensorflowonspark_tpu import reservation
+
+            addr = transport.addr_tuple(self.roster_addr)
+            client = reservation.Client(addr)
+            try:
+                client.register({
+                    "executor_id": self.replica_id,
+                    "host": self.host,
+                    "port": self.port,
+                    "addr": "{}:{}".format(self.host, self.port),
+                    "job_name": "serving",
+                    "task_index": self.task_index,
+                })
+            finally:
+                client.close()
+            self._hb = reservation.HeartbeatSender(
+                addr, self.replica_id, self.heartbeat_interval,
+                metrics_provider=self.heartbeat_metrics).start()
+        logger.info("gateway %s serving on %s:%d (max_batch=%d, "
+                    "max_wait=%.1fms, max_queue=%d)", self.replica_id,
+                    self.host, self.port, self.max_batch,
+                    self.max_wait * 1e3, self.max_queue)
+        return (self.host, self.port)
+
+    def stop(self, goodbye=True):
+        """Drain: stop accepting, shed the queue with code ``shutdown``,
+        deregister from the roster."""
+        with self._cond:
+            self._stopped = True
+            pending = list(self._queue)
+            self._queue.clear()
+            self._cond.notify_all()
+        for req in pending:
+            self._safe_error(req, "shutdown", "gateway stopping")
+        if self._hb is not None:
+            self._hb.stop(goodbye=goodbye, reason="done")
+            self._hb = None
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- admission + batching ----------------------------------------------
+
+    def submit(self, feed, count, deadline_ms=None):
+        """In-process entry: enqueue one request and block for its result.
+        Raises :class:`OverloadError` when shed.  ``feed`` is
+        ``{tensor: array}`` with ``count`` leading rows."""
+        done = threading.Event()
+        box = {}
+
+        def on_result(outputs):
+            box["out"] = outputs
+            done.set()
+
+        def on_error(code, message):
+            box["err"] = OverloadError(code, message)
+            done.set()
+
+        self._enqueue(feed, count, deadline_ms, on_result, on_error)
+        done.wait()
+        if "err" in box:
+            raise box["err"]
+        return box["out"]
+
+    def _enqueue(self, feed, count, deadline_ms, on_result, on_error):
+        deadline = None
+        if deadline_ms is not None:
+            deadline = time.monotonic() + deadline_ms / 1000.0
+        req = _Request(feed, count, deadline, on_result, on_error)
+        with self._cond:
+            if self._stopped:
+                shed = ("shutdown", "gateway stopping")
+            elif len(self._queue) >= self.max_queue:
+                shed = ("overload",
+                        "queue full ({} pending, max_queue={})".format(
+                            len(self._queue), self.max_queue))
+            else:
+                shed = None
+                self._queue.append(req)
+                depth = len(self._queue)
+                if depth > self._queue_depth_hwm:
+                    self._queue_depth_hwm = depth
+                self._cond.notify()
+        if shed is not None:
+            with self._metrics_lock:
+                self.shed_total += 1
+            self._safe_error(req, *shed)
+
+    def _batch_loop(self):
+        """Continuous batcher: wait for the first request, then coalesce
+        arrivals until the batch is full or the oldest request has waited
+        ``max_wait``; expired requests are shed *before* dispatch."""
+        while True:
+            batch = self._collect_batch()
+            if batch is None:
+                return  # stopped
+            if batch:
+                try:
+                    self._dispatch(batch)
+                except Exception as e:  # defensive: batcher must survive
+                    logger.exception("gateway batch dispatch failed")
+                    for req in batch:
+                        self._safe_error(req, "internal", repr(e))
+
+    def _collect_batch(self):
+        expired = []
+        try:
+            with self._cond:
+                while not self._queue and not self._stopped:
+                    self._cond.wait(timeout=0.1)
+                if self._stopped:
+                    return None
+                flush_at = self._queue[0].arrival + self.max_wait
+                batch, rows = [], 0
+                while True:
+                    while self._queue:
+                        req = self._queue[0]
+                        if rows and rows + req.count > self.max_batch:
+                            return batch  # carry overflow to the next batch
+                        self._queue.popleft()
+                        if (req.deadline is not None
+                                and time.monotonic() > req.deadline):
+                            expired.append(req)
+                            continue
+                        batch.append(req)
+                        rows += req.count
+                        if rows >= self.max_batch:
+                            return batch
+                    remaining = flush_at - time.monotonic()
+                    if remaining <= 0 or self._stopped:
+                        return batch
+                    self._cond.wait(timeout=remaining)
+        finally:
+            # shed callbacks write to client sockets: never under the lock
+            if expired:
+                with self._metrics_lock:
+                    self.shed_total += len(expired)
+                for req in expired:
+                    self._safe_error(
+                        req, "deadline",
+                        "deadline expired after {:.1f}ms in queue".format(
+                            (time.monotonic() - req.arrival) * 1e3))
+
+    def _dispatch(self, batch):
+        total = sum(r.count for r in batch)
+        if len(batch) == 1:
+            feed = batch[0].feed
+        else:
+            keys = batch[0].feed.keys()
+            feed = {k: np.concatenate([r.feed[k] for r in batch])
+                    for k in keys}
+        outputs = self.server.predict_feed(feed, total)
+        now = time.monotonic()
+        from tensorflowonspark_tpu.serving import bucket_for
+
+        fill = 100.0 * total / bucket_for(total, self.server.buckets)
+        with self._metrics_lock:
+            self.batches_total += 1
+            self.requests_total += len(batch)
+            self.rows_total += total
+            self._batch_fill_pct = fill
+            for req in batch:
+                self._lat_us.append((now - req.arrival) * 1e6)
+        lo = 0
+        for req in batch:
+            hi = lo + req.count
+            sliced = {k: v[lo:hi] for k, v in outputs.items()}
+            lo = hi
+            try:
+                req.on_result(sliced)
+            except Exception:
+                logger.debug("result callback failed (client gone?)",
+                             exc_info=True)
+
+    @staticmethod
+    def _safe_error(req, code, message):
+        try:
+            req.on_error(code, message)
+        except Exception:
+            logger.debug("error callback failed (client gone?)",
+                         exc_info=True)
+
+    # -- metrics ------------------------------------------------------------
+
+    def heartbeat_metrics(self):
+        """Flat counter/gauge dict piggybacked on each roster beat (and
+        polled directly by the bench leg).  Key suffixes follow the
+        observatory contract: ``_hwm``/``_max`` render as gauges, the rest
+        as monotonic counters."""
+        with self._metrics_lock:
+            lat = sorted(self._lat_us)
+            depth_hwm = self._queue_depth_hwm
+            out = {
+                "serving_requests": self.requests_total,
+                "serving_rows": self.rows_total,
+                "serving_batches": self.batches_total,
+                "serving_shed": self.shed_total,
+                "serving_compiles": self.server.compile_count,
+                "serving_queue_depth_hwm": depth_hwm,
+                "serving_batch_fill_pct_max": round(self._batch_fill_pct, 2),
+            }
+        if lat:
+            out["serving_p50_us_max"] = round(lat[len(lat) // 2], 1)
+            out["serving_p99_us_max"] = round(
+                lat[min(len(lat) - 1, int(len(lat) * 0.99))], 1)
+        return out
+
+    # -- network front ------------------------------------------------------
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                conn, peer = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            self._conns.add(conn)
+            t = threading.Thread(target=self._serve_conn, args=(conn, peer),
+                                 name="gateway-conn", daemon=True)
+            t.start()
+
+    def _serve_conn(self, conn, peer):
+        """One client connection: hello handshake, then a predict loop.
+        The reader enqueues; responses are written by the batcher thread
+        through the request callbacks (Transport serializes sends), so one
+        connection can keep many requests in flight."""
+        t = Transport(conn)
+        try:
+            hello = t.recv_control()
+            if hello.get("type") != "hello":
+                t.send_abort("protocol", "expected hello")
+                return
+            t.server_hello(hello, extra={
+                "max_batch": self.max_batch,
+                "buckets": list(self.server.buckets),
+                "replica_id": self.replica_id,
+            })
+            while True:
+                kind, msg = t.recv_message()
+                if kind != transport.K_JSON:
+                    t.send_abort("protocol", "expected control frame")
+                    return
+                mtype = msg.get("type")
+                if mtype == "ping":
+                    t.send_control({"type": "pong",
+                                    "replica_id": self.replica_id})
+                    continue
+                if mtype == "bye":
+                    return
+                if mtype != "predict":
+                    t.send_abort("protocol",
+                                 "unknown message {!r}".format(mtype))
+                    return
+                self._handle_predict(t, msg)
+        except (EOFError, OSError, TransportError):
+            pass  # client went away; nothing to clean but the socket
+        finally:
+            self._conns.discard(conn)
+            t.close()
+
+    def _handle_predict(self, t, msg):
+        rid = msg.get("id")
+        kind, payload = t.recv_message()
+        columns, count, _ = Transport.decode_columns(kind, payload,
+                                                     copy=False)
+        names = msg.get("tensors") or [None] * len(columns)
+        # signature-driven dtype/shape coercion: clients may send float64
+        # JSON-born columns; the bucketizer must land them on the compiled
+        # dtype or every batch would trace a fresh program
+        feed = {}
+        for name, col in zip(names, columns):
+            coerced = self.server._coerce(
+                name if name in self.server.signature else None, col)
+            feed[name or "_x"] = coerced
+
+        def on_result(outputs):
+            out_names = sorted(outputs)
+            cols = [np.ascontiguousarray(outputs[n]) for n in out_names]
+            t.send_control({"type": "result", "id": rid,
+                            "count": int(msg.get("count", count)),
+                            "outputs": out_names})
+            t.send_columns(cols, len(cols[0]) if cols else 0)
+
+        def on_error(code, message):
+            t.send_control({"type": "error", "id": rid, "code": code,
+                            "message": message})
+
+        self._enqueue(feed, count, msg.get("deadline_ms"),
+                      on_result, on_error)
+
+
+class GatewayChannel(object):
+    """A client connection to ONE gateway replica (request/response over
+    the shared transport; one in-flight request at a time per channel)."""
+
+    def __init__(self, addr, timeout=30.0, client_id=None):
+        self.addr = transport.addr_tuple(addr)
+        sock = socket.create_connection(self.addr, timeout=timeout)
+        sock.settimeout(timeout)
+        self.transport = Transport(sock)
+        reply = self.transport.client_hello(
+            extra={"client": client_id or "gateway-client"})
+        self.max_batch = reply.get("max_batch")
+        self.buckets = reply.get("buckets")
+        self.replica_id = reply.get("replica_id")
+        self._next_id = 0
+        self._lock = threading.Lock()
+
+    def predict(self, feed, count, deadline_ms=None):
+        """One round trip: ``feed`` is ``{tensor: array-like}`` with
+        ``count`` leading rows; returns ``{name: np.ndarray}``.  Raises
+        :class:`OverloadError` on a typed shed, EOFError/OSError when the
+        replica died (HA clients retry elsewhere)."""
+        names = sorted(feed)
+        columns = [np.ascontiguousarray(np.asarray(feed[n]))
+                   for n in names]
+        with self._lock:
+            self._next_id += 1
+            rid = self._next_id
+            msg = {"type": "predict", "id": rid, "count": int(count),
+                   "tensors": names}
+            if deadline_ms is not None:
+                msg["deadline_ms"] = float(deadline_ms)
+            self.transport.send_control(msg)
+            self.transport.send_columns(columns, int(count))
+            reply = self.transport.recv_control()
+            if reply.get("type") == "error":
+                raise OverloadError(reply.get("code", "error"),
+                                    reply.get("message", ""))
+            if reply.get("type") != "result":
+                raise TransportError("unexpected reply {!r}".format(reply))
+            kind, payload = self.transport.recv_message()
+            cols, _, _ = Transport.decode_columns(kind, payload, copy=True)
+        return dict(zip(reply.get("outputs", []), cols))
+
+    def ping(self):
+        with self._lock:
+            self.transport.send_control({"type": "ping"})
+            return self.transport.recv_control()
+
+    def close(self):
+        try:
+            with self._lock:
+                self.transport.send_control({"type": "bye"})
+        except (OSError, EOFError):
+            pass
+        self.transport.close()
+
+
+class ServingClient(object):
+    """HA client over N gateway replicas: discovers the fleet from the
+    reservation roster (or a static address list) and retries a failed
+    request on a surviving replica.  Prediction is idempotent, so a
+    request that was in flight on a killed replica is simply re-sent —
+    this is how an *accepted* request survives a replica SIGKILL.
+
+    :class:`OverloadError` is NOT retried here: a typed shed is the
+    gateway telling this client to back off, and hammering a sibling
+    replica would defeat admission control.  Callers own that policy.
+    """
+
+    def __init__(self, replicas=None, roster_addr=None, timeout=30.0,
+                 roster_timeout=60.0, client_id=None):
+        self.timeout = timeout
+        self.client_id = client_id
+        if replicas is None:
+            if roster_addr is None:
+                raise ValueError("need replicas=[addr...] or roster_addr")
+            replicas = self._discover(roster_addr, roster_timeout)
+        self.replicas = [transport.addr_tuple(a) for a in replicas]
+        if not self.replicas:
+            raise ValueError("no serving replicas found")
+        self._idx = 0
+        self._chan = None
+        self.failovers = 0
+
+    @staticmethod
+    def _discover(roster_addr, timeout):
+        """Roster bootstrap: wait for the full roster (get_reservations is
+        None until every slot registers), keep the ``serving`` rows."""
+        from tensorflowonspark_tpu import reservation
+
+        client = reservation.Client(transport.addr_tuple(roster_addr))
+        try:
+            info = client.await_reservations(timeout=timeout)
+        finally:
+            client.close()
+        return ["{}:{}".format(m["host"], m["port"]) for m in info
+                if isinstance(m, dict) and m.get("job_name") == "serving"]
+
+    def _channel(self):
+        if self._chan is not None:
+            return self._chan
+        last = None
+        for _ in range(len(self.replicas)):
+            addr = self.replicas[self._idx % len(self.replicas)]
+            try:
+                self._chan = GatewayChannel(addr, timeout=self.timeout,
+                                            client_id=self.client_id)
+                return self._chan
+            except OSError as e:
+                last = e
+                self._idx += 1
+        raise ConnectionError(
+            "no serving replica reachable (tried {}): {}".format(
+                self.replicas, last))
+
+    def _drop_channel(self):
+        if self._chan is not None:
+            try:
+                self._chan.transport.close()
+            except OSError:
+                pass
+            self._chan = None
+        self._idx += 1
+        self.failovers += 1
+
+    def predict(self, feed, count, deadline_ms=None):
+        """Predict with failover: transport-level failures rotate to the
+        next replica, trying each one once before giving up."""
+        last = None
+        for _ in range(len(self.replicas) + 1):
+            try:
+                return self._channel().predict(feed, count,
+                                               deadline_ms=deadline_ms)
+            except OverloadError:
+                raise
+            except (EOFError, OSError, ConnectionError,
+                    TransportError) as e:
+                last = e
+                self._drop_channel()
+        raise ConnectionError(
+            "predict failed on every replica: {!r}".format(last))
+
+    def close(self):
+        if self._chan is not None:
+            self._chan.close()
+            self._chan = None

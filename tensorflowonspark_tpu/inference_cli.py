@@ -15,6 +15,17 @@ Usage:
         --schema_hint 'struct<image:array<float>,label:bigint>' \
         --input_mapping '{"image": "image"}' \
         --output /path/to/preds.jsonl
+
+``--serve`` switches to ONLINE mode: instead of draining a TFRecord set,
+the process becomes one continuous-batching gateway replica
+(:class:`~tensorflowonspark_tpu.gateway.GatewayServer`) and runs until
+SIGTERM/SIGINT, mirroring ``dataservice_worker.py``'s lifecycle.  Pass
+``--roster host:port`` to join a replica fleet behind the reservation
+server (failover via the elastic-recovery plane):
+
+    python -m tensorflowonspark_tpu.inference_cli \
+        --export_dir /path/to/export --serve --port 8500 \
+        --max-batch 64 --max-wait-ms 5 --roster driver:41111
 """
 
 import argparse
@@ -116,13 +127,42 @@ def run_inference_native(export_dir, rows, plugin_path, input_mapping=None,
             yield row
 
 
+def serve_forever(args):
+    """``--serve``: run one gateway replica until SIGTERM/SIGINT (the
+    ``dataservice_worker.py`` lifecycle — print a ready line, wait on a
+    signal-set event, drain on the way out)."""
+    import signal
+    import threading
+
+    from tensorflowonspark_tpu import gateway, serving, telemetry
+
+    telemetry.configure_from_meta({})
+    telemetry.install_sigusr1()
+    server = serving.ModelServer(args.export_dir, args.max_batch)
+    gw = gateway.GatewayServer(
+        server, host=args.host, port=args.port,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue=args.max_queue, roster_addr=args.roster,
+        replica_id=args.replica_id, task_index=args.task_index,
+        heartbeat_interval=args.heartbeat)
+    host, port = gw.start()
+    print("serving replica {} ready on {}:{} (buckets {})".format(
+        gw.replica_id, host, port, list(server.buckets)), flush=True)
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    done.wait()
+    gw.stop()
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         description="Batch inference over TFRecords with a framework export "
-                    "(reference Inference.scala)")
+                    "(reference Inference.scala); --serve runs an online "
+                    "continuous-batching gateway replica instead")
     parser.add_argument("--export_dir", required=True)
-    parser.add_argument("--input", required=True,
-                        help="TFRecord directory")
+    parser.add_argument("--input", default=None,
+                        help="TFRecord directory (required unless --serve)")
     parser.add_argument("--schema_hint", default=None,
                         help="struct<name:type,...> (reference --schema_hint)")
     parser.add_argument("--input_mapping", default=None,
@@ -137,7 +177,35 @@ def main(argv=None):
                              "export with the embedded_mlir artifact")
     parser.add_argument("--output", default=None,
                         help="output JSON-lines path (stdout when omitted)")
+    serve = parser.add_argument_group("online serving (--serve)")
+    serve.add_argument("--serve", action="store_true",
+                       help="run as a gateway replica instead of batch mode")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=0,
+                       help="listen port (0 = ephemeral, printed on ready)")
+    serve.add_argument("--max-batch", type=int, default=None, dest="max_batch",
+                       help="batch coalescing cap (default: --batch_size)")
+    serve.add_argument("--max-wait-ms", type=float, default=5.0,
+                       dest="max_wait_ms",
+                       help="batching latency budget per request")
+    serve.add_argument("--max-queue", type=int, default=None, dest="max_queue",
+                       help="admission-control queue bound "
+                            "(default 4 * max_batch)")
+    serve.add_argument("--roster", default=None,
+                       help="reservation server host:port to register with")
+    serve.add_argument("--replica-id", default=None, dest="replica_id")
+    serve.add_argument("--task-index", type=int, default=0, dest="task_index")
+    serve.add_argument("--heartbeat", type=float, default=1.0,
+                       help="roster heartbeat interval seconds")
     args = parser.parse_args(argv)
+
+    if args.serve:
+        if args.max_batch is None:
+            args.max_batch = args.batch_size
+        serve_forever(args)
+        return
+    if not args.input:
+        parser.error("--input is required (or pass --serve for online mode)")
 
     hint = schema_mod.parse(args.schema_hint) if args.schema_hint else None
     input_mapping = json.loads(args.input_mapping) if args.input_mapping else None

@@ -31,7 +31,13 @@ Metric vocabulary: every counter key that rides heartbeats appears as
 ``tfos_<key>_total`` (counter) or ``tfos_<key>`` (gauge, for ``_hwm`` /
 ``_max`` keys), labeled ``{executor="<id>"}``, plus the
 cluster-level ``tfos_nodes``, ``tfos_scrapes_total``, and the windowed
-``tfos_rate{key=...}`` gauges derived from the ring.
+``tfos_rate{key=...}`` gauges derived from the ring.  The serving
+gateway (PR 11) registers in the same roster under ``job_name="serving"``
+and exports through the same pipe: ``tfos_serving_requests_total`` /
+``_rows_total`` / ``_batches_total`` / ``_shed_total`` /
+``_compiles_total`` counters plus ``tfos_serving_p50_us_max`` /
+``_p99_us_max``, ``tfos_serving_queue_depth_hwm`` and
+``tfos_serving_batch_fill_pct_max`` gauges per replica.
 """
 
 import json

@@ -306,6 +306,36 @@ def run_embedded_native_many(export_dir, feeds, plugin_path,
             shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bucket_ladder(max_batch):
+    """Power-of-two padded-batch ladder up to and including ``max_batch``.
+
+    Shared between :class:`ModelServer` (remainder batches) and the serving
+    gateway's continuous batcher: every dispatched batch is padded up to
+    one of these sizes, so the jit cache holds at most ``len(ladder)``
+    entries and — after :meth:`ModelServer.warmup` — no request ever pays
+    a compile.  ``max_batch`` itself is always the top rung even when it
+    is not a power of two.
+    """
+    if max_batch < 1:
+        raise ValueError("max_batch must be >= 1, got %r" % (max_batch,))
+    ladder, b = [], 1
+    while b < max_batch:
+        ladder.append(b)
+        b *= 2
+    ladder.append(max_batch)
+    return tuple(ladder)
+
+
+def bucket_for(count, ladder):
+    """Smallest ladder rung holding ``count`` rows (the pad target).
+    Counts above the top rung return ``count`` unchanged — the caller is
+    dispatching an oversized batch and pays its own compile."""
+    for b in ladder:
+        if count <= b:
+            return b
+    return count
+
+
 def _stablehlo_platform_mismatch(exc):
     """Whether ``exc`` is jax.export's first-call lowering-platform refusal
     (the only failure :meth:`ModelServer.predict_feed` may degrade on).
@@ -342,6 +372,13 @@ class ModelServer(object):
 
         params, desc = checkpoint.load_model(export_dir)
         self.batch_size = batch_size
+        #: The padded-batch ladder every dispatch is rounded up to; the
+        #: serving gateway reads this so client batches land on warm shapes.
+        self.buckets = bucket_ladder(batch_size)
+        #: Distinct batch shapes dispatched so far — a proxy for jit cache
+        #: entries.  Flat after warmup() == zero per-request compiles.
+        self.compile_count = 0
+        self._seen_buckets = set()
         self.params = params
         self.descriptor = desc
         self.signature = _normalize_signature(desc.get("input_signature"))
@@ -493,15 +530,56 @@ class ModelServer(object):
 
     # -- prediction -------------------------------------------------------
 
+    def zero_feed(self, rows):
+        """A zero-filled feed dict with ``rows`` leading rows, shaped from
+        the export's input signature — the warmup payload.  ``None`` when
+        the signature is absent or has unknown non-batch dims (nothing to
+        shape a dummy batch from)."""
+        if not self.signature:
+            return None
+        feed = {}
+        for tensor, spec in self.signature.items():
+            tail = list((spec.get("shape") or [None])[1:])
+            if any(d is None for d in tail):
+                return None
+            feed[tensor] = np.zeros([rows] + [int(d) for d in tail],
+                                    np.dtype(spec["dtype"]))
+        return feed
+
+    def warmup(self):
+        """AOT-compile every bucket shape before traffic arrives: one
+        zero-filled dispatch per ladder rung, largest first so the full
+        batch — the steady-state shape — is warm earliest.  Returns the
+        number of buckets warmed (0 when the signature can't shape a
+        dummy feed; those exports warm lazily on first use instead)."""
+        warmed = 0
+        for b in reversed(self.buckets):
+            feed = self.zero_feed(b)
+            if feed is None:
+                return warmed
+            self.predict_feed(feed, b)
+            warmed += 1
+        return warmed
+
     def predict_feed(self, feed, count):
         """Run one (padded) batch; returns the raw model outputs sliced back
-        to ``count`` rows, normalized to a dict of arrays."""
-        if count < self.batch_size:
+        to ``count`` rows, normalized to a dict of arrays.
+
+        Ragged batches pad up to the nearest :func:`bucket_ladder` rung —
+        NOT always to ``batch_size`` — so a stream of varying remainders
+        reuses at most ``len(self.buckets)`` compiled shapes instead of
+        tracing a fresh program per distinct tail size.
+        """
+        bucket = bucket_for(count, self.buckets)
+        if bucket > count:
             def pad(x):
-                width = [(0, self.batch_size - count)] + [(0, 0)] * (x.ndim - 1)
+                width = [(0, bucket - count)] + [(0, 0)] * (x.ndim - 1)
                 return np.pad(x, width)
 
             feed = {k: pad(v) for k, v in feed.items()}
+        if bucket not in self._seen_buckets:
+            self._seen_buckets.add(bucket)
+            self.compile_count += 1
         try:
             out = self._predict(self.params, feed)
         except Exception as first:

@@ -107,6 +107,12 @@ DEFAULT_CONFIG = {
     # heartbeat-miss streak: newest sample older than interval * this
     # fires BEFORE the liveness fence (which waits heartbeat_misses beats)
     "heartbeat_miss_beats": 2.0,
+    # serving latency SLO: p99 objective in microseconds (0 disarms the
+    # rule — there is no universal latency target) and the fraction of
+    # in-window samples that must violate it before the burn alert fires
+    # (a lone spike is noise; sustained burn pages like a straggler does)
+    "latency_slo_p99_us": 0.0,
+    "latency_slo_burn_frac": 0.5,
     # alert plumbing
     "cooldown_secs": 30.0,
     "max_alerts": 256,
@@ -237,6 +243,7 @@ class RuleEngine(object):
             ("infeed_starved", self._rule_infeed_starved),
             ("dataservice_saturation", self._rule_dataservice_saturation),
             ("cache_thrash", self._rule_cache_thrash),
+            ("latency_slo_burn", self._rule_latency_slo_burn),
             ("heartbeat_miss", self._rule_heartbeat_miss),
         )
 
@@ -498,6 +505,41 @@ class RuleEngine(object):
                             "evictions vs {} hits in {:.0f}s — raise "
                             "cache_bytes / TFOS_DS_CACHE_BYTES".format(
                                 node, evictions, hits, d["span_secs"])))
+        return alerts
+
+    def _rule_latency_slo_burn(self, window, now):
+        """Alert when a serving replica burns its latency SLO: at least
+        ``latency_slo_burn_frac`` of the in-window samples report a
+        ``serving_p50/p99`` window p99 (``serving_p99_us_max`` gauge) at or
+        above the ``latency_slo_p99_us`` objective.  Disarmed by default
+        (objective 0) — set the objective per deployment.  The alert
+        carries the window's shed count so the responder can tell
+        "overloaded and shedding" from "slow but admitting"."""
+        cfg = self.config
+        slo = cfg["latency_slo_p99_us"]
+        if not slo:
+            return []
+        alerts = []
+        for node, samples in window.items():
+            if len(samples) < cfg["min_samples"]:
+                continue
+            p99s = [m.get("serving_p99_us_max") for _, m in samples]
+            p99s = [v for v in p99s if _finite(v)]
+            if len(p99s) < cfg["min_samples"]:
+                continue
+            burning = sum(1 for v in p99s if v >= slo)
+            frac = burning / float(len(p99s))
+            if frac < cfg["latency_slo_burn_frac"]:
+                continue
+            d = window_deltas(samples)
+            shed = (d["deltas"].get("serving_shed", 0) if d else 0)
+            alerts.append(self._alert(
+                "latency_slo_burn", now, executor=node, severity="warn",
+                value=round(frac, 3), threshold=cfg["latency_slo_burn_frac"],
+                p99_us=p99s[-1], slo_us=slo, shed=shed,
+                message="replica {} burning latency SLO: p99 {:.0f}us >= "
+                        "{:.0f}us in {:.0%} of window samples ({} shed)"
+                        .format(node, p99s[-1], slo, frac, shed)))
         return alerts
 
     def _rule_heartbeat_miss(self, window, now):

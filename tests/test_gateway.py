@@ -1,0 +1,300 @@
+"""Serving-gateway tests: bucket ladder + AOT warm paths, the continuous
+batcher's edge cases (empty deadline flush, light load, dtype coercion,
+typed shed), transport frame parity with the wire matrix, and HA failover
+through the shared transport."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import checkpoint, gateway, serving, transport
+from tensorflowonspark_tpu.gateway import (GatewayChannel, GatewayServer,
+                                           OverloadError, ServingClient)
+from tensorflowonspark_tpu.transport import Transport, TransportError
+
+from test_wire_formats import NUMERIC_DTYPES
+
+
+# ---------------------------------------------------------------------------
+# bucket ladder (satellite: remainder batches reuse compiled buckets)
+# ---------------------------------------------------------------------------
+
+def test_bucket_ladder_powers_of_two():
+    assert serving.bucket_ladder(128) == (1, 2, 4, 8, 16, 32, 64, 128)
+    assert serving.bucket_ladder(1) == (1,)
+    # a non-power-of-two cap is still the top rung
+    assert serving.bucket_ladder(6) == (1, 2, 4, 6)
+    with pytest.raises(ValueError):
+        serving.bucket_ladder(0)
+
+
+def test_bucket_for_rounds_up():
+    ladder = serving.bucket_ladder(16)
+    assert serving.bucket_for(1, ladder) == 1
+    assert serving.bucket_for(3, ladder) == 4
+    assert serving.bucket_for(16, ladder) == 16
+    # above the ladder: dispatch unpadded (caller pays its own compile)
+    assert serving.bucket_for(33, ladder) == 33
+
+
+@pytest.fixture(scope="module")
+def linear_export(tmp_path_factory):
+    """Registry-fallback linear export: y = 2*x0 + 3*x1 (no StableHLO)."""
+    export_dir = str(tmp_path_factory.mktemp("gw") / "export")
+    params = {"dense": {"kernel": np.asarray([[2.0], [3.0]], np.float32),
+                        "bias": np.zeros((1,), np.float32)}}
+    checkpoint.export_model(export_dir, params, "linear",
+                            model_config={"features": 1},
+                            input_signature={"x": [None, 2]})
+    return export_dir
+
+
+def test_predict_feed_pads_remainder_to_bucket(linear_export):
+    server = serving.ModelServer(linear_export, batch_size=8)
+    shapes = []
+    real = server._predict
+
+    def spy(params, feed):
+        shapes.append(feed["x"].shape[0])
+        return real(params, feed)
+
+    server._predict = spy
+    feed = {"x": np.asarray([[1.0, 1.0], [2.0, 0.0], [0.0, 1.0]],
+                            np.float32)}
+    out = server.predict_feed(feed, 3)
+    # 3 rows pad to the 4-rung, NOT to batch_size=8, and slice back to 3
+    assert shapes == [4]
+    np.testing.assert_allclose(out["output"][:, 0], [5.0, 4.0, 3.0],
+                               rtol=1e-5)
+    # a second distinct remainder on the same rung reuses the shape
+    server.predict_feed({"x": np.zeros((4, 2), np.float32)}, 4)
+    assert shapes == [4, 4]
+    assert server.compile_count == 1
+
+
+def test_warmup_compiles_every_bucket_once(linear_export):
+    server = serving.ModelServer(linear_export, batch_size=8)
+    assert server.warmup() == 4  # ladder (1, 2, 4, 8)
+    assert server.compile_count == 4
+    # every post-warmup dispatch lands on a warm shape: counter stays flat
+    for count in (1, 2, 3, 5, 8):
+        server.predict_feed({"x": np.zeros((count, 2), np.float32)}, count)
+    assert server.compile_count == 4
+
+
+# ---------------------------------------------------------------------------
+# continuous batcher edge cases
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def gw(linear_export):
+    server = serving.ModelServer(linear_export, batch_size=8)
+    g = GatewayServer(server, max_wait_ms=3.0)
+    g.start()
+    yield g
+    g.stop()
+
+
+def test_empty_flush_on_deadline(gw):
+    # no traffic for several max_wait windows: the batcher must idle
+    # without dispatching empty batches or spinning
+    time.sleep(0.05)
+    assert gw.batches_total == 0
+    assert gw.requests_total == 0
+
+
+def test_single_request_under_light_load(gw):
+    out = gw.submit({"x": np.asarray([[1.0, 1.0]], np.float32)}, 1)
+    assert abs(float(out["output"][0][0]) - 5.0) < 1e-5
+    assert gw.batches_total == 1 and gw.rows_total == 1
+    m = gw.heartbeat_metrics()
+    assert m["serving_p99_us_max"] > 0
+    assert m["serving_batch_fill_pct_max"] == 100.0  # 1 row on the 1-rung
+
+
+def test_dtype_coercion_through_bucketizer(gw):
+    # a remote client sends JSON-born float64 / int columns; the gateway
+    # must coerce onto the signature dtype or every batch re-traces
+    ch = GatewayChannel((gw.host, gw.port))
+    try:
+        compiles_before = gw.server.compile_count
+        out = ch.predict({"x": np.asarray([[1, 1], [2, 0]], np.int64)}, 2)
+        np.testing.assert_allclose(out["output"][:, 0], [5.0, 4.0],
+                                   rtol=1e-5)
+        out = ch.predict({"x": np.asarray([[1.0, 1.0]], np.float64)}, 1)
+        assert abs(float(out["output"][0][0]) - 5.0) < 1e-5
+        assert gw.server.compile_count == compiles_before
+    finally:
+        ch.close()
+
+
+def test_expired_deadline_shed_before_dispatch(gw):
+    before = gw.batches_total
+    with pytest.raises(OverloadError) as exc:
+        gw.submit({"x": np.zeros((1, 2), np.float32)}, 1, deadline_ms=-1.0)
+    assert exc.value.code == "deadline"
+    assert gw.heartbeat_metrics()["serving_shed"] == 1
+    assert gw.batches_total == before  # shed happened pre-dispatch
+
+
+def test_queue_full_sheds_with_overload(linear_export):
+    server = serving.ModelServer(linear_export, batch_size=8)
+    g = GatewayServer(server, max_wait_ms=1.0, max_queue=2)
+    # no start(): the batcher never runs, so the queue only fills
+    g._enqueue({"x": np.zeros((1, 2), np.float32)}, 1, None,
+               lambda out: None, lambda code, msg: None)
+    g._enqueue({"x": np.zeros((1, 2), np.float32)}, 1, None,
+               lambda out: None, lambda code, msg: None)
+    errs = []
+    g._enqueue({"x": np.zeros((1, 2), np.float32)}, 1, None,
+               lambda out: None, lambda code, msg: errs.append(code))
+    assert errs == ["overload"]
+    assert g.shed_total == 1
+
+
+def test_batch_coalescing_under_concurrent_load(gw):
+    outs = {}
+
+    def hit(i):
+        outs[i] = gw.submit(
+            {"x": np.asarray([[float(i), 1.0]], np.float32)}, 1)
+
+    threads = [threading.Thread(target=hit, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(outs) == 16
+    for i, out in outs.items():
+        assert abs(float(out["output"][0][0]) - (2.0 * i + 3.0)) < 1e-4
+    assert gw.requests_total == 16
+    # coalescing happened: fewer dispatches than requests under burst load
+    assert gw.batches_total <= 16
+
+
+# ---------------------------------------------------------------------------
+# transport frame parity (the wire-format matrix, over a live socketpair)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", NUMERIC_DTYPES,
+                         ids=[np.dtype(d).name for d in NUMERIC_DTYPES])
+def test_request_response_colv1_roundtrip(dtype):
+    a, b = socket.socketpair()
+    ta, tb = Transport(a), Transport(b)
+    try:
+        rng = np.random.default_rng(7)
+        col = (rng.random((6, 3)) * 100).astype(dtype)
+        kind = ta.send_columns([col], 6)
+        assert kind == transport.K_COLV1
+        k, payload = tb.recv_message()
+        cols, count, tuple_rows = Transport.decode_columns(k, payload)
+        assert count == 6 and not tuple_rows
+        assert cols[0].dtype == np.dtype(dtype)
+        np.testing.assert_array_equal(cols[0], col)
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_transport_object_column_falls_back_to_pickle():
+    a, b = socket.socketpair()
+    ta, tb = Transport(a), Transport(b)
+    try:
+        col = np.asarray(["ragged", "objects"], dtype=object)
+        kind = ta.send_columns([col], 2)
+        assert kind == transport.K_PICKLE
+        k, payload = tb.recv_message()
+        cols, count, _ = Transport.decode_columns(k, payload)
+        assert count == 2 and list(cols[0]) == ["ragged", "objects"]
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_transport_abort_surfaces_typed_error():
+    a, b = socket.socketpair()
+    ta, tb = Transport(a), Transport(b)
+    try:
+        ta.send_abort("overload", "queue full", queued=32)
+        with pytest.raises(TransportError, match="overload"):
+            tb.recv_message()
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_transport_hello_negotiates_codec():
+    a, b = socket.socketpair()
+    ta, tb = Transport(a), Transport(b)
+    out = {}
+
+    def client():
+        out["reply"] = ta.client_hello(extra={"client": "t"})
+
+    t = threading.Thread(target=client)
+    t.start()
+    hello = tb.recv_control()
+    assert hello["type"] == "hello" and hello["codecs"]
+    codec = tb.server_hello(hello, extra={"max_batch": 4})
+    t.join()
+    assert out["reply"]["type"] == "hello_ok"
+    assert out["reply"]["max_batch"] == 4
+    assert ta.codec == tb.codec == codec
+    ta.close()
+    tb.close()
+
+
+def test_dataservice_framing_is_the_shared_transport():
+    # the extraction must leave dataservice's stream path running on the
+    # exact same framing objects (one protocol, not a drifted copy)
+    from tensorflowonspark_tpu import dataservice
+
+    assert dataservice._DHEADER is transport.DHEADER
+    assert dataservice._recv_frame is transport.recv_frame
+    assert dataservice._send_frame is transport.send_frame
+    assert dataservice._K_COLV1 == transport.K_COLV1
+
+
+# ---------------------------------------------------------------------------
+# HA client failover
+# ---------------------------------------------------------------------------
+
+def test_serving_client_retries_on_survivor(linear_export):
+    servers = [serving.ModelServer(linear_export, batch_size=4)
+               for _ in range(2)]
+    gws = [GatewayServer(s, max_wait_ms=1.0) for s in servers]
+    addrs = ["{}:{}".format(*g.start()) for g in gws]
+    try:
+        client = ServingClient(replicas=addrs)
+        feed = {"x": np.asarray([[2.0, 0.0]], np.float32)}
+        assert abs(float(client.predict(feed, 1)["output"][0][0])
+                   - 4.0) < 1e-5
+        # kill whichever replica the client is pinned to; the next predict
+        # must fail over to the survivor instead of surfacing the EOF
+        gws[client._idx % 2].stop()
+        assert abs(float(client.predict(feed, 1)["output"][0][0])
+                   - 4.0) < 1e-5
+        assert client.failovers >= 1
+        client.close()
+    finally:
+        for g in gws:
+            g.stop()
+
+
+def test_overload_is_not_retried_on_siblings(linear_export):
+    server = serving.ModelServer(linear_export, batch_size=4)
+    g = GatewayServer(server, max_wait_ms=1.0)
+    addr = "{}:{}".format(*g.start())
+    try:
+        client = ServingClient(replicas=[addr, addr])
+        with pytest.raises(OverloadError) as exc:
+            client.predict({"x": np.zeros((1, 2), np.float32)}, 1,
+                           deadline_ms=-1.0)
+        assert exc.value.code == "deadline"
+        assert client.failovers == 0  # a typed shed must not hammer siblings
+        client.close()
+    finally:
+        g.stop()

@@ -91,7 +91,8 @@ LM_STEPS_PER_CALL = int(os.environ.get("TFOS_BENCH_LM_SPC", 20))
 LEG_TIMEOUT_SECS = {"mnist": 1500, "resnet": 1800, "transformer": 1800,
                     "feedplane": 600, "ceiling": 120,
                     "dataservice_cached_epoch": 300,
-                    "serving_latency": 300}
+                    "serving_latency": 300,
+                    "warm_start": 600}
 
 
 # ---------------------------------------------------------------------------
@@ -773,6 +774,121 @@ def measure_serving_latency(points=(1, 8, 32), secs_per_point=1.2,
     }
 
 
+# The warm-start child: one "node lifetime" in a fresh interpreter — point
+# the compile plane at the shared root, build a Trainer over the AOT store,
+# pay (or skip) the compile, report the debt.  Run twice against one root
+# by measure_warm_start: run 1 is the cold node, run 2 is the elastic
+# replacement / restarted job.
+_WARM_START_CHILD = r"""
+import json, os, sys, time
+
+t_start = time.perf_counter()
+
+import numpy as np
+import jax.numpy as jnp
+import optax
+
+from tensorflowonspark_tpu import compilecache
+from tensorflowonspark_tpu.train import Trainer
+
+root = sys.argv[1]
+compilecache.configure(root, register_feed=False)
+
+
+def loss(params, batch, mask):
+    h = jnp.tanh(batch["x"] @ params["w1"])
+    pred = h @ params["w2"]
+    err = (pred - batch["y"]) ** 2 * mask
+    return err.sum() / jnp.maximum(mask.sum(), 1.0), pred
+
+
+rng = np.random.RandomState(0)
+params = {"w1": jnp.asarray(rng.randn(64, 128).astype("float32") * 0.1),
+          "w2": jnp.asarray(rng.randn(128).astype("float32") * 0.1)}
+tr = Trainer(loss, params, optax.adam(1e-3), batch_size=32,
+             log_steps=10 ** 6, aot_cache=os.path.join(root, "aot"))
+batch = {"x": jnp.ones((32, 64)), "y": jnp.ones((32,))}
+t0 = time.perf_counter()
+tr.step(batch)
+first_step = time.perf_counter() - t0
+for _ in range(4):
+    tr.step(batch)
+# the production fit path also runs the K-steps-per-dispatch scan program;
+# a warm rejoin must skip BOTH compiles, so both count toward the debt
+tr.repeat_step(batch, jnp.ones((32,), jnp.float32), 4)
+snap = tr.counters_snapshot()
+cache = compilecache.stats.counters_snapshot()
+print(json.dumps({
+    "first_step_secs": first_step,
+    "start_to_first_step_secs": time.perf_counter() - t_start,
+    "train_compile_us": int(snap.get("train_compile_us_max", 0)),
+    "aot_compile_us": cache["compile_cache_aot_compile_us"],
+    "aot_load_us": cache["compile_cache_aot_load_us"],
+    "cache_hit": cache["compile_cache_hit"],
+    "cache_miss": cache["compile_cache_miss"],
+    "verdicts": dict(tr._aot_verdicts),
+}))
+"""
+
+
+def measure_warm_start():
+    """Warm-start compile plane: the compile debt a restarted/replacement
+    node pays over a shared cache root vs the cold first node.
+
+    Two identical child interpreters run the same Trainer lifetime against
+    one fresh cache root.  The first is the cold node: it traces, XLA-
+    compiles, and persists both the disk cache entries and the serialized
+    AOT step executable.  The second is the warm rejoin: its step program
+    deserializes (never traces) and its canonical-program estimate rides
+    the disk cache.  Per run the debt is ``train_compile_us`` (the
+    canonical-program compile wall) plus ``compile_cache_aot_compile_us``
+    (the explicit lower+compile the AOT store paid); the headline speedup
+    is cold debt over warm debt.  Pinned to CPU: the leg grades the cache
+    plumbing, not the accelerator, and must not burn tunnel time."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    cache_root = tempfile.mkdtemp(prefix="bench_warmstart_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the leg subprocess exports the repo-local .jax_cache; the whole point
+    # here is measuring a COLD first run against a fresh root
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+    def run_once():
+        proc = subprocess.run(
+            [sys.executable, "-c", _WARM_START_CHILD, cache_root],
+            cwd=root, env=env, capture_output=True, text=True, timeout=240)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                "warm-start child rc={}: {}".format(
+                    proc.returncode, proc.stderr[-500:]))
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    cold = run_once()
+    warm = run_once()
+
+    def debt_secs(run):
+        return (run["train_compile_us"] + run["aot_compile_us"]) / 1e6
+
+    cold_secs = debt_secs(cold)
+    warm_secs = debt_secs(warm)
+    return {
+        "warm_start_cold_secs": round(cold_secs, 3),
+        "warm_start_warm_secs": round(warm_secs, 3),
+        "warm_start_speedup": round(cold_secs / max(warm_secs, 1e-9), 2),
+        "cold_first_step_secs": round(cold["first_step_secs"], 3),
+        "warm_first_step_secs": round(warm["first_step_secs"], 3),
+        "cold_start_to_first_step_secs": round(
+            cold["start_to_first_step_secs"], 3),
+        "warm_start_to_first_step_secs": round(
+            warm["start_to_first_step_secs"], 3),
+        "cold_verdicts": cold["verdicts"],
+        "warm_verdicts": warm["verdicts"],
+        "warm_cache_hits": warm["cache_hit"],
+        "warm_aot_load_us": warm["aot_load_us"],
+        "backend": "cpu",
+    }
+
+
 _LEGS = {
     "mnist": measure_mnist_e2e,
     "resnet": measure_resnet50,
@@ -781,6 +897,7 @@ _LEGS = {
     "ceiling": measure_reference_feed_ceiling,
     "dataservice_cached_epoch": measure_dataservice_cached_epoch,
     "serving_latency": measure_serving_latency,
+    "warm_start": measure_warm_start,
 }
 
 
@@ -815,6 +932,14 @@ def _leg_subprocess(leg, out_path):
     return proc
 
 
+# Per-attempt probe transcript for the round artifact: every probe_device
+# attempt this process ran (the up-front probe, per-leg health re-probes,
+# recoveries) appends {attempt, elapsed, error} here, and main() publishes
+# it as `probe_history` — so a degraded round's JSON shows WHEN the tunnel
+# was tried, how long each attempt hung, and what it said, instead of one
+# flattened error string.
+PROBE_HISTORY = []
+
 # Probe budget: a remotely-attached TPU's first jax init has been observed
 # to take >150s through a cold tunnel, so the r05 150s default produced
 # "timed out" probes against a device that was actually reachable — and
@@ -841,17 +966,24 @@ def probe_device(timeout=None, attempts=3, retry_sleep=60):
     for attempt in range(attempts):
         if attempt:
             time.sleep(retry_sleep * (2 ** (attempt - 1)))
+        t0 = time.time()
         try:
             proc = subprocess.run([sys.executable, "-c", code],
                                   timeout=timeout, capture_output=True,
                                   text=True)
             if proc.returncode == 0 and proc.stdout.strip():
+                PROBE_HISTORY.append({"attempt": attempt + 1,
+                                      "elapsed": round(time.time() - t0, 1),
+                                      "error": None})
                 return proc.stdout.strip().splitlines()[-1], None
             err = "device probe rc={}: {}".format(
                 proc.returncode, proc.stderr[-300:])
         except subprocess.TimeoutExpired:
             err = ("device probe timed out after {}s (accelerator/tunnel "
                    "unreachable)".format(timeout))
+        PROBE_HISTORY.append({"attempt": attempt + 1,
+                              "elapsed": round(time.time() - t0, 1),
+                              "error": err})
         print("bench: {} (attempt {}/{})".format(err, attempt + 1, attempts),
               file=sys.stderr)
     return None, err
@@ -1051,6 +1183,7 @@ def main():
     ceiling, ceiling_err = run_leg_isolated("ceiling")
     dscache, dscache_err = run_leg_isolated("dataservice_cached_epoch")
     servlat, servlat_err = run_leg_isolated("serving_latency")
+    warmstart, warmstart_err = run_leg_isolated("warm_start")
     # The transformer leg runs LAST — after every graded leg,
     # including the device-free ones: it is beyond the BASELINE
     # targets (extra evidence, not the headline), so a flap burning
@@ -1199,6 +1332,22 @@ def main():
             "compiles_after_warmup")
     elif servlat_err:
         out["serving_latency_error"] = servlat_err
+    if warmstart:
+        # warm-start compile plane: the compile debt (canonical-program
+        # wall + explicit AOT lower/compile) a restarted node pays over a
+        # shared cache root, vs the cold first node over the same root
+        out["warm_start_cold_secs"] = warmstart.get("warm_start_cold_secs")
+        out["warm_start_warm_secs"] = warmstart.get("warm_start_warm_secs")
+        out["warm_start_speedup"] = warmstart.get("warm_start_speedup")
+        out["warm_start_detail"] = {
+            "cold_first_step_secs": warmstart.get("cold_first_step_secs"),
+            "warm_first_step_secs": warmstart.get("warm_first_step_secs"),
+            "warm_verdicts": warmstart.get("warm_verdicts"),
+            "warm_cache_hits": warmstart.get("warm_cache_hits"),
+            "backend": warmstart.get("backend"),
+        }
+    elif warmstart_err:
+        out["warm_start_error"] = warmstart_err
     if mnist:
         n_dev = max(int(mnist.get("n_devices", 1)), 1)
         ips = mnist["avg_exp_per_second"] / n_dev
@@ -1241,7 +1390,12 @@ def main():
         "ceiling": (ceiling or {}).get("value_source"),
         "dataservice_cached_epoch": (dscache or {}).get("value_source"),
         "serving_latency": (servlat or {}).get("value_source"),
+        "warm_start": (warmstart or {}).get("value_source"),
     }
+    # diagnosability: the per-attempt probe transcript — successes and
+    # failures both, in the order they ran (up-front probe, per-leg health
+    # re-probes, recoveries)
+    out["probe_history"] = PROBE_HISTORY
     for name, err in (("resnet50_error", resnet_err),
                       ("mnist_error", mnist_err),
                       ("transformer_error", lm_err),

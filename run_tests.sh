@@ -51,7 +51,11 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # load, the pinned replica SIGKILLed mid-run and fenced by heartbeat
 # timeout, zero accepted requests lost across the failover, and the
 # serving telemetry (nonzero tfos_serving_p99_us / tfos_serving_batch_fill
-# plus a live latency_slo_burn alert) on /metrics and /alerts
+# plus a live latency_slo_burn alert) on /metrics and /alerts, and prove
+# the warm-start compile plane: a SIGKILLed worker's replacement rejoins
+# with a deserialized (never retraced) step executable, compile debt a
+# small fraction of the cold nodes', exact element totals preserved, and
+# nonzero tfos_compile_cache_hit_total on a live /metrics scrape
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -62,5 +66,6 @@ python scripts/ci_assert_observatory.py
 python scripts/ci_assert_profiling.py
 python scripts/ci_assert_watchtower.py
 python scripts/ci_assert_serving.py
+python scripts/ci_assert_warmstart.py
 
 exit $rc

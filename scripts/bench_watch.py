@@ -303,6 +303,10 @@ HEADLINE_METRICS = (
     ("serving_saturation_qps", "serving_latency", "higher"),
     ("serving_batch_speedup", "serving_latency", "higher"),
     ("serving_p99_us", "serving_latency", "lower"),
+    # warm-start compile plane (absent pre-round-12, skipped by run_diff)
+    ("warm_start_cold_secs", "warm_start", "lower"),
+    ("warm_start_warm_secs", "warm_start", "lower"),
+    ("warm_start_speedup", "warm_start", "higher"),
 )
 
 

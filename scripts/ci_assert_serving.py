@@ -40,17 +40,20 @@ REQS_PER_CLIENT = 60
 KILL_AFTER = 20          # per-client requests before the SIGKILL lands
 
 
-def _spawn_replica(roster_addr, replica_id, task_index, export_dir):
+def _spawn_replica(roster_addr, replica_id, task_index, export_dir,
+                   warm_dir=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
-    return subprocess.Popen(
-        [sys.executable, "-m", "tensorflowonspark_tpu.inference_cli",
-         "--export_dir", export_dir, "--serve", "--port", "0",
-         "--roster", "{}:{}".format(*roster_addr),
-         "--replica-id", replica_id, "--task-index", str(task_index),
-         "--max-batch", "8", "--max-wait-ms", "5", "--heartbeat", "0.25"],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    cmd = [sys.executable, "-m", "tensorflowonspark_tpu.inference_cli",
+           "--export_dir", export_dir, "--serve", "--port", "0",
+           "--roster", "{}:{}".format(*roster_addr),
+           "--replica-id", replica_id, "--task-index", str(task_index),
+           "--max-batch", "8", "--max-wait-ms", "5", "--heartbeat", "0.25"]
+    if warm_dir:
+        cmd += ["--warm-cache-dir", warm_dir]
+    return subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
 
 
 def _get(base, path):
@@ -91,8 +94,26 @@ def main():
     roster_addr = resv.start()
     base = "http://{}:{}".format(*obs.addr)
 
-    procs = [_spawn_replica(roster_addr, "ci-s0", 0, export_dir),
-             _spawn_replica(roster_addr, "ci-s1", 1, export_dir)]
+    # both replicas share one warm-start root: the first persists each
+    # bucket rung's serialized executable, the second (spawned once the
+    # first's artifacts stop appearing — the restarted-replica shape)
+    # deserializes instead of compiling
+    warm_dir = os.path.join(tmp, "warm")
+    procs = [_spawn_replica(roster_addr, "ci-s0", 0, export_dir, warm_dir)]
+    deadline = time.time() + BUDGET_SECS / 2
+    seen, stable_since = -1, time.time()
+    while True:
+        n = (len([f for f in os.listdir(warm_dir) if f.endswith(".aotx")])
+             if os.path.isdir(warm_dir) else 0)
+        if n != seen:
+            seen, stable_since = n, time.time()
+        elif n > 0 and time.time() - stable_since > 1.0:
+            break
+        assert time.time() < deadline, \
+            "first replica never persisted a warm rung artifact"
+        time.sleep(0.1)
+    procs.append(_spawn_replica(roster_addr, "ci-s1", 1, export_dir,
+                                warm_dir))
     t0 = time.time()
     killed = threading.Event()
     try:
@@ -107,6 +128,24 @@ def main():
                 if isinstance(m, dict) and m.get("job_name") == "serving"]
         assert len(rows) == 2, \
             "roster did not expose 2 serving replicas: {}".format(info)
+        # warm-start opt-in: every replica's registration carries its
+        # per-rung warmup verdicts, and the second replica — spawned
+        # against the first's persisted artifacts — must have warmed
+        # entirely by deserialization (zero compiles, the restarted-
+        # replica guarantee)
+        for m in rows:
+            rep = m.get("warmup")
+            assert rep and rep.get("buckets"), \
+                "replica {} registered without a warmup report: {}".format(
+                    m.get("executor_id"), m)
+        warm_row = next(m for m in rows if m["executor_id"] == "ci-s1")
+        assert warm_row["warmup"]["compiled"] == 0, \
+            "second replica recompiled despite the shared warm dir: " \
+            "{}".format(warm_row["warmup"])
+        assert warm_row["warmup"]["loaded"] == len(
+            warm_row["warmup"]["buckets"]), \
+            "second replica has non-loaded rungs: {}".format(
+                warm_row["warmup"])
         addrs = ["{}:{}".format(m["host"], m["port"]) for m in rows]
         # every fresh client pins to roster index 0 — that's the replica
         # the kill must land on for the failover to be exercised

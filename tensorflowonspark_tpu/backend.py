@@ -36,11 +36,37 @@ import tempfile
 import threading
 import time
 import traceback
+import weakref
 
 import cloudpickle
 from multiprocessing import get_context
+from multiprocessing import util as _mp_util
 
 logger = logging.getLogger(__name__)
+
+#: LocalBackends that have not been stop()ped.  A leaked backend would hang
+#: interpreter shutdown: multiprocessing's exit hook joins non-daemon
+#: children, and an idle executor blocks on its command pipe forever (the
+#: executors can't be daemonic — their tasks fork manager-server children).
+#: A plain ``atexit`` handler can't help: multiprocessing registers its own
+#: lazily at the first spawn, so LIFO ordering would run the join loop
+#: first.  ``util.Finalize`` with an exitpriority runs INSIDE that hook,
+#: before the join loop, so leaked executors are stopped in time.
+_live_backends = weakref.WeakSet()
+
+
+def _reap_leaked_backends():
+    for backend in list(_live_backends):
+        if not backend._stopped:
+            logger.warning(
+                "LocalBackend leaked (never stopped); stopping at exit")
+            try:
+                backend.stop()
+            except Exception:
+                pass
+
+
+_mp_util.Finalize(None, _reap_leaked_backends, exitpriority=100)
 
 
 def partition(data, num_partitions):
@@ -215,6 +241,7 @@ class LocalBackend(object):
         self._stopped = False
         self._excluded = set()  # executor indices fenced off from scheduling
         self._lock = threading.Lock()  # guards _procs/_conns growth
+        _live_backends.add(self)
         for i in range(num_executors):
             overrides = dict(env or {})
             if env_per_executor:
@@ -296,6 +323,10 @@ class LocalBackend(object):
         from tensorflowonspark_tpu import telemetry
         with telemetry.get_tracer().span("backend/provision_replacement"):
             with self._lock:
+                if self._stopped:
+                    # A liveness monitor racing teardown must not spawn an
+                    # executor nobody will ever stop.
+                    raise RuntimeError("backend stopped; no replacements")
                 i = len(self._procs)
                 overrides = dict(self._base_env)
                 overrides.update(env or {})
@@ -390,16 +421,26 @@ class LocalBackend(object):
         return self.foreach_partition_async(partitions, fn).wait(timeout)
 
     def stop(self):
-        self._stopped = True
-        for conn in self._conns:
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            conns = list(self._conns)
+            procs = list(self._procs)
+        _live_backends.discard(self)
+        for conn in conns:
             try:
                 conn.send(None)
             except OSError:
                 pass
-        for proc in self._procs:
+        for proc in procs:
             proc.join(timeout=5)
             if proc.is_alive():
                 proc.terminate()
+                proc.join(timeout=5)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=1)
         if self._owns_root:
             shutil.rmtree(self.workdir_root, ignore_errors=True)
 

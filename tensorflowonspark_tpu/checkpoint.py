@@ -52,6 +52,20 @@ def _fs_path(path):
     return path if fsio.is_remote(path) else os.path.abspath(path)
 
 
+def aot_root(directory):
+    """The AOT executable store beside a checkpoint root.
+
+    Warm rejoin and restore share one directory tree: a replacement node
+    that can see the checkpoints can also see the serialized step
+    executables (:mod:`~tensorflowonspark_tpu.compilecache`), so
+    ``fit_supervised`` restores state AND dispatches without retracing
+    from the same mount.  The subdirectory name is outside the
+    ``ckpt-<step>`` namespace, so checkpoint retention/quarantine never
+    touches it.
+    """
+    return os.path.join(_fs_path(directory), "aot_executables")
+
+
 class CheckpointManager(object):
     """Chief-only periodic checkpointing of a train-state pytree.
 

@@ -24,6 +24,7 @@ import time
 import uuid
 
 from tensorflowonspark_tpu import backend as backend_mod
+from tensorflowonspark_tpu import compilecache as compilecache_mod
 from tensorflowonspark_tpu import node, reservation
 from tensorflowonspark_tpu import telemetry as telemetry_mod
 
@@ -530,7 +531,8 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         release_port=True, profiler=False, executor_env=None,
         driver_ps_nodes=False, heartbeat_interval=5.0, heartbeat_misses=3,
         telemetry=False, telemetry_dir=None, data_service=None,
-        observatory=False, observatory_port=0, watchtower=None):
+        observatory=False, observatory_port=0, watchtower=None,
+        compile_cache_dir=None):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -596,6 +598,16 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         journal at ``<log_dir>/watchtower/journal.jsonl`` (replayable
         offline via ``scripts/metrics_replay.py``).  Suspect-node
         verdicts land in ``tf_status["suspects"]``.
+      compile_cache_dir: warm-start compile plane
+        (:mod:`~tensorflowonspark_tpu.compilecache`): every node points
+        JAX's persistent compilation cache at this cluster-shared
+        directory before touching any backend, so an elastic replacement
+        node (which re-runs the same start closure) rejoins by
+        deserializing instead of recompiling — ``train_compile_us_max``
+        collapses from seconds to milliseconds and
+        ``tfos_compile_cache_hit`` counts the saves on ``/metrics``.
+        Falls back to the ``TFOS_COMPILE_CACHE_DIR`` env var; None with
+        no env leaves the compile plane off.
     """
     if hasattr(cluster_backend, "parallelize"):  # raw SparkContext
         cluster_backend = backend_mod.SparkBackend(cluster_backend)
@@ -818,6 +830,13 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         "heartbeat_interval": heartbeat_interval,
         "telemetry": telemetry_mod.meta_spec(telemetry, tdir),
         "data_service": data_service,
+        # Resolved on the DRIVER (env fallback included) so every node —
+        # and every future replacement — shares one cache root even when
+        # only the driver's environment names it.
+        "compile_cache_dir": (os.path.abspath(compile_cache_dir)
+                              if compile_cache_dir
+                              else os.environ.get(
+                                  compilecache_mod.CACHE_DIR_ENV)),
     }
     tracer.instant("cluster/start", num_executors=num_executors,
                    input_mode=str(input_mode),

@@ -1,0 +1,458 @@
+"""Warm-start compile plane: persistent XLA compilation cache + serialized
+AOT executables.
+
+The reference framework restarts a failed TF node cheaply because graph
+construction is fast; the jax_graft equivalent pays a full XLA recompile of
+every jitted step (and every serving bucket rung) on each elastic
+replacement, gateway restart, and bench leg.  This module makes that a
+one-time cost shared across runs and replicas (the tf.data fixed-cost
+amortization argument, arXiv:2101.12127), on two tiers:
+
+1. **Persistent compilation cache** (:func:`configure`): points JAX's
+   ``jax_compilation_cache_dir`` at a cluster-shared directory resolved
+   from cluster config / :data:`CACHE_DIR_ENV`.  Every ``.compile()`` in
+   the process — trainer steps, serving rungs, ``estimate_step_cost``'s
+   canonical program — then reads/writes the disk cache, so a replacement
+   node's compiles collapse to deserialization.  Hit/miss/saved-time
+   counters are derived from jax's monitoring events and ride heartbeats
+   into the observatory as ``tfos_compile_cache_*``.
+
+2. **AOT executable store** (:class:`AOTCache`): explicit
+   ``jax.experimental.serialize_executable`` round trips, keyed by a
+   field-by-field :func:`fingerprint` (jax/jaxlib + backend version, mesh
+   shape, donation signature, batch/param avals).  A warm rejoin
+   deserializes and dispatches **without ever tracing**; any fingerprint
+   mismatch, corrupt artifact, or unsupported executable is a clean miss
+   — the caller falls back to ordinary JIT and ``compile_cache_fallback``
+   increments.  A warm start is an optimization, never a correctness
+   dependency.
+
+Scoping contract: fingerprints cover everything jax can see (versions,
+devices, mesh, donation, avals) but NOT the Python closure being compiled
+— two different models with identical aval signatures would collide on the
+same store.  Callers therefore scope the store directory per model run
+(the trainer defaults it beside the checkpoint root, see
+``checkpoint.aot_root``; serving keys by export dir).
+"""
+
+import logging
+import os
+import pickle
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+#: env fallback for the shared cache root (cluster config wins; see
+#: :func:`configure_from_meta`).  ``configure`` re-exports the resolved
+#: path here so forked children (manager, feed tasks) inherit it.
+CACHE_DIR_ENV = "TFOS_COMPILE_CACHE_DIR"
+
+#: bump when the artifact layout changes — old artifacts then read as
+#: fingerprint mismatches (clean JIT fallback), not crashes
+_FORMAT = 1
+
+_SUFFIX = ".aotx"
+
+# jax monitoring event names the counters are derived from (stable across
+# the jax versions this repo supports; unknown names just never fire).
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_SAVED_EVENT = "/jax/compilation_cache/compile_time_saved_sec"
+_RETRIEVAL_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
+
+
+class _CacheStats(object):
+    """Process-global compile-plane tallies (plain ints, the DataFeed
+    pattern: written on the compile path, read torn-but-harmlessly by the
+    heartbeat thread).  Registered once as a node metrics feed by
+    :func:`configure`, so the counters ride HBEAT payloads and render on
+    the observatory as ``tfos_compile_cache_*``."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.cache_hit = 0          # persistent-cache hits (jax event)
+        self.cache_miss = 0         # persistent-cache misses (jax event)
+        self.fallback = 0           # AOT artifacts rejected -> JIT fallback
+        self.saved_us = 0           # compile time the disk cache saved
+        self.retrieval_us = 0       # time spent reading cached executables
+        self.aot_load = 0           # AOT executables deserialized + loaded
+        self.aot_save = 0           # AOT executables serialized + persisted
+        self.aot_load_us = 0
+        self.aot_compile_us = 0     # explicit lower+compile on cold stores
+        self.aot_bytes_read = 0
+        self.aot_bytes_written = 0
+        self._dir_bytes = 0
+        self._dir_scan_t = 0.0
+
+    def _dir_bytes_now(self):
+        """Cache-directory footprint gauge, rescanned at most every 5s
+        (the cache writes flat files; a beat-rate listdir is cheap but
+        not free)."""
+        d = _configured_dir
+        if not d:
+            return 0
+        now = time.time()
+        if now - self._dir_scan_t >= 5.0:
+            self._dir_scan_t = now
+            total = 0
+            try:
+                for name in os.listdir(d):
+                    try:
+                        total += os.path.getsize(os.path.join(d, name))
+                    except OSError:
+                        pass
+            except OSError:
+                pass
+            self._dir_bytes = total
+        return self._dir_bytes
+
+    def counters_snapshot(self):
+        """Flat counters for heartbeat payloads /
+        :func:`~tensorflowonspark_tpu.telemetry.merge_counters`:
+        ``compile_cache_hit`` / ``compile_cache_miss`` persistent-cache
+        outcomes, ``compile_cache_saved_us`` compile time the cache saved,
+        ``compile_cache_retrieval_us`` time spent reading cached
+        executables, ``compile_cache_fallback`` AOT artifacts rejected
+        (mismatch/corrupt) in favor of JIT, ``compile_cache_aot_load`` /
+        ``compile_cache_aot_save`` AOT store traffic with byte and
+        microsecond tallies, and ``compile_cache_dir_bytes_hwm`` the
+        cache directory footprint (``_hwm`` -> merged by max, rendered
+        as a gauge)."""
+        return {
+            "compile_cache_hit": self.cache_hit,
+            "compile_cache_miss": self.cache_miss,
+            "compile_cache_fallback": self.fallback,
+            "compile_cache_saved_us": self.saved_us,
+            "compile_cache_retrieval_us": self.retrieval_us,
+            "compile_cache_aot_load": self.aot_load,
+            "compile_cache_aot_save": self.aot_save,
+            "compile_cache_aot_load_us": self.aot_load_us,
+            "compile_cache_aot_compile_us": self.aot_compile_us,
+            "compile_cache_aot_bytes_read": self.aot_bytes_read,
+            "compile_cache_aot_bytes_written": self.aot_bytes_written,
+            "compile_cache_dir_bytes_hwm": self._dir_bytes_now(),
+        }
+
+
+#: the process-global tally instance every helper below writes to
+stats = _CacheStats()
+
+_lock = threading.Lock()
+_listeners_installed = False
+_feed_registered = False
+_configured_dir = None
+
+
+def _on_event(event, **kwargs):
+    if event == _HIT_EVENT:
+        stats.cache_hit += 1
+    elif event == _MISS_EVENT:
+        stats.cache_miss += 1
+
+
+def _on_duration(event, duration=0.0, **kwargs):
+    if event == _SAVED_EVENT:
+        # jax reports saved = original compile - retrieval, which goes
+        # NEGATIVE for millisecond-scale programs; clamp per event so the
+        # counter stays a monotone "time not spent recompiling"
+        stats.saved_us += max(0, int(duration * 1e6))
+    elif event == _RETRIEVAL_EVENT:
+        stats.retrieval_us += int(duration * 1e6)
+
+
+def _install_listeners():
+    """Subscribe the tallies to jax's monitoring events (idempotent).
+    Returns False on jax versions without the monitoring module — the
+    cache still works, the hit/miss counters just stay zero."""
+    global _listeners_installed
+    with _lock:
+        if _listeners_installed:
+            return True
+        try:
+            from jax._src import monitoring
+        except ImportError:
+            return False
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _listeners_installed = True
+        return True
+
+
+def _register_stats_feed():
+    """Publish :data:`stats` on this node's heartbeats (idempotent; no-op
+    outside a node process — gateway replicas merge the snapshot into
+    their own heartbeat_metrics instead)."""
+    global _feed_registered
+    with _lock:
+        if _feed_registered:
+            return
+        _feed_registered = True
+    from tensorflowonspark_tpu import node
+
+    node._register_feed(stats)
+
+
+def configured_dir():
+    """The active persistent-cache directory, or None before
+    :func:`configure` succeeds."""
+    return _configured_dir
+
+
+def configure(cache_dir=None, register_feed=True):
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Resolution order: explicit argument, then :data:`CACHE_DIR_ENV`.
+    Returns the resolved (created) directory, or None when neither names
+    one — the whole compile plane is then inert, zero-cost.
+
+    Side effects on success: ``jax_compilation_cache_dir`` set, the
+    min-compile-time threshold dropped to 0 (CI/bench-scale programs
+    compile in milliseconds — the default 1s gate would exclude exactly
+    the compiles the warm-rejoin story needs cached), monitoring
+    listeners installed, the env var re-exported for forked children,
+    and (``register_feed=True``) :data:`stats` registered as a node
+    heartbeat feed.
+    """
+    global _configured_dir
+    cache_dir = cache_dir or os.environ.get(CACHE_DIR_ENV) or None
+    if not cache_dir:
+        return None
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception:  # pragma: no cover - knob renamed across versions
+        pass
+    _install_listeners()
+    os.environ[CACHE_DIR_ENV] = cache_dir
+    with _lock:
+        _configured_dir = cache_dir
+    if register_feed:
+        _register_stats_feed()
+    from tensorflowonspark_tpu import telemetry
+
+    telemetry.get_tracer().instant("compile/cache_configured", dir=cache_dir)
+    logger.info("persistent compilation cache at %s", cache_dir)
+    return cache_dir
+
+
+def configure_from_meta(cluster_meta):
+    """Configure from ``cluster_meta["compile_cache_dir"]`` (remote
+    processes — replacement nodes re-run the same start closure, so warm
+    rejoin needs no extra plumbing); falls back to the env toggle, same
+    policy as ``telemetry.configure_from_meta``."""
+    return configure((cluster_meta or {}).get("compile_cache_dir"))
+
+
+# -- AOT executable store -------------------------------------------------
+
+def _aval_signature(tree):
+    """Stable hash of a pytree's array avals (tree structure + per-leaf
+    shape/dtype) — the batch/param half of a fingerprint.  Hashed rather
+    than stored raw: a params tree's treedef repr runs to kilobytes."""
+    import hashlib
+
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", None)
+        parts.append("%s:%s" % (dtype if dtype is not None
+                                else type(leaf).__name__, shape))
+    return hashlib.sha256("|".join(parts).encode("utf-8")).hexdigest()
+
+
+def fingerprint(avals=None, mesh=None, donate=(), extra=None):
+    """The compatibility key an AOT artifact is stored and checked under.
+
+    A field-by-field dict (not one opaque hash) so a mismatch names the
+    field that moved — the load path logs and traces exactly which of
+    jax/jaxlib version, backend, device count, mesh shape, donation
+    signature, or aval signature diverged before falling back to JIT.
+    """
+    import jax
+
+    try:
+        import jaxlib.version as jaxlib_version_mod
+
+        jaxlib_version = jaxlib_version_mod.__version__
+    except Exception:  # pragma: no cover - stripped envs
+        jaxlib_version = "unknown"
+    fp = {
+        "format": _FORMAT,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib_version,
+        "backend": jax.default_backend(),
+        "device_count": jax.device_count(),
+        "donate": tuple(donate),
+    }
+    if mesh is not None:
+        try:
+            fp["mesh"] = repr(tuple(zip(mesh.axis_names,
+                                        mesh.devices.shape)))
+        except Exception:
+            fp["mesh"] = repr(mesh)
+    if avals is not None:
+        fp["avals"] = _aval_signature(avals)
+    if extra:
+        fp.update(extra)
+    return fp
+
+
+class AOTCache(object):
+    """Serialized-executable store: ``name`` -> one fingerprinted artifact.
+
+    Artifacts are pickle files (``<name>.aotx``) holding the fingerprint
+    dict plus the ``jax.experimental.serialize_executable`` triple
+    ``(payload, in_tree, out_tree)``, written atomically (tmp + rename)
+    so a killed writer can never leave a half artifact under a reader.
+    Absent / mismatched / corrupt artifacts are all clean misses.
+    """
+
+    def __init__(self, directory):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+
+    def path(self, name):
+        return os.path.join(self.directory, name + _SUFFIX)
+
+    def load(self, name, fp):
+        """Deserialize + load ``name``'s executable when its stored
+        fingerprint equals ``fp`` exactly; None otherwise.  Mismatch,
+        corruption, and deserialize failures bump
+        ``compile_cache_fallback`` and emit a ``compile/jit_fallback``
+        instant naming the reason — absence is silent (a cold store is
+        not a fallback)."""
+        from tensorflowonspark_tpu import telemetry
+
+        path = self.path(name)
+        if not os.path.exists(path):
+            return None
+        tracer = telemetry.get_tracer()
+        t0 = time.perf_counter()
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+            doc = pickle.loads(blob)
+            stored = doc["fingerprint"]
+        except Exception as e:
+            stats.fallback += 1
+            logger.warning("AOT artifact %s unreadable (%s: %s); "
+                           "falling back to JIT", path, type(e).__name__, e)
+            tracer.instant("compile/jit_fallback", program=name,
+                           reason="corrupt")
+            return None
+        if stored != fp:
+            stats.fallback += 1
+            diff = sorted(k for k in set(stored) | set(fp)
+                          if stored.get(k) != fp.get(k))
+            logger.warning("AOT artifact %s fingerprint mismatch on %s; "
+                           "falling back to JIT", path, diff)
+            tracer.instant("compile/jit_fallback", program=name,
+                           reason="fingerprint:" + ",".join(diff))
+            return None
+        try:
+            from jax.experimental import serialize_executable as se
+
+            import jax
+
+            compiled = se.deserialize_and_load(
+                doc["payload"], doc["in_tree"], doc["out_tree"],
+                backend=jax.default_backend())
+        except Exception as e:
+            stats.fallback += 1
+            logger.warning("AOT artifact %s failed to load (%s: %s); "
+                           "falling back to JIT", path, type(e).__name__, e)
+            tracer.instant("compile/jit_fallback", program=name,
+                           reason="deserialize")
+            return None
+        micros = int((time.perf_counter() - t0) * 1e6)
+        stats.aot_load += 1
+        stats.aot_load_us += micros
+        stats.aot_bytes_read += len(blob)
+        tracer.instant("compile/aot_load", program=name, micros=micros,
+                       bytes=len(blob))
+        return compiled
+
+    def save(self, name, fp, compiled):
+        """Serialize ``compiled`` under ``name``; returns whether an
+        artifact landed.  Never raises: executables that don't support
+        serialization (no unloaded form) and I/O failures log and skip —
+        the run proceeds on its live executable either way."""
+        from tensorflowonspark_tpu import telemetry
+
+        t0 = time.perf_counter()
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload, in_tree, out_tree = se.serialize(compiled)
+            blob = pickle.dumps(
+                {"fingerprint": fp, "payload": payload,
+                 "in_tree": in_tree, "out_tree": out_tree},
+                protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:
+            logger.warning("AOT serialize of %s failed (%s: %s); "
+                           "artifact skipped", name, type(e).__name__, e)
+            return False
+        path = self.path(name)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.warning("AOT artifact write %s failed (%s); skipped",
+                           path, e)
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        micros = int((time.perf_counter() - t0) * 1e6)
+        stats.aot_save += 1
+        stats.aot_bytes_written += len(blob)
+        telemetry.get_tracer().instant("compile/aot_save", program=name,
+                                       micros=micros, bytes=len(blob))
+        return True
+
+
+def load_or_compile(cache, name, fp, jit_fn, args):
+    """The load-or-compile decision shared by the trainer and serving.
+
+    Returns ``(compiled, verdict, micros)``: the AOT store's deserialized
+    executable (``"loaded"`` — zero tracing, the warm-rejoin path), or an
+    explicitly lowered+compiled one persisted for the next restart
+    (``"compiled"``), or ``(None, "jit", 0)`` when there is no store /
+    even explicit compilation fails — callers then dispatch the plain
+    jit fn.
+    """
+    from tensorflowonspark_tpu import telemetry
+
+    if cache is None:
+        return None, "jit", 0
+    t0 = time.perf_counter()
+    compiled = cache.load(name, fp)
+    if compiled is not None:
+        return compiled, "loaded", int((time.perf_counter() - t0) * 1e6)
+    t0 = time.perf_counter()
+    try:
+        with telemetry.get_tracer().span("compile/aot_compile",
+                                         program=name):
+            compiled = jit_fn.lower(*args).compile()
+    except Exception as e:
+        logger.warning("explicit AOT compile of %s failed (%s: %s); "
+                       "dispatching via JIT", name, type(e).__name__, e)
+        return None, "jit", 0
+    micros = int((time.perf_counter() - t0) * 1e6)
+    stats.aot_compile_us += micros
+    cache.save(name, fp, compiled)
+    return compiled, "compiled", micros

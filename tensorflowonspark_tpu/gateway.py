@@ -148,8 +148,11 @@ class GatewayServer(object):
         ``(host, port)``."""
         if self._warmup:
             warmed = self.server.warmup()
-            logger.info("gateway %s: %d bucket(s) warm (ladder %s)",
-                        self.replica_id, warmed, self.server.buckets)
+            report = getattr(self.server, "warmup_report", None) or {}
+            logger.info("gateway %s: %d bucket(s) warm (ladder %s, "
+                        "%d loaded / %d compiled)",
+                        self.replica_id, warmed, self.server.buckets,
+                        report.get("loaded", 0), report.get("compiled", 0))
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((self.host, self.port))
@@ -169,15 +172,21 @@ class GatewayServer(object):
 
             addr = transport.addr_tuple(self.roster_addr)
             client = reservation.Client(addr)
+            reg = {
+                "executor_id": self.replica_id,
+                "host": self.host,
+                "port": self.port,
+                "addr": "{}:{}".format(self.host, self.port),
+                "job_name": "serving",
+                "task_index": self.task_index,
+            }
+            # Per-rung load-vs-compile verdicts travel on the roster
+            # registration, so the driver can place them in tf_status
+            # without a second channel.
+            if getattr(self.server, "warmup_report", None):
+                reg["warmup"] = self.server.warmup_report
             try:
-                client.register({
-                    "executor_id": self.replica_id,
-                    "host": self.host,
-                    "port": self.port,
-                    "addr": "{}:{}".format(self.host, self.port),
-                    "job_name": "serving",
-                    "task_index": self.task_index,
-                })
+                client.register(reg)
             finally:
                 client.close()
             self._hb = reservation.HeartbeatSender(
@@ -377,6 +386,20 @@ class GatewayServer(object):
             out["serving_p50_us_max"] = round(lat[len(lat) // 2], 1)
             out["serving_p99_us_max"] = round(
                 lat[min(len(lat) - 1, int(len(lat) * 0.99))], 1)
+        report = getattr(self.server, "warmup_report", None)
+        if report:
+            out["serving_warm_loaded"] = report["loaded"]
+            out["serving_warm_compiled"] = report["compiled"]
+        try:
+            # Compile-plane tallies (persistent-cache hits, AOT loads):
+            # gateway replicas run outside a node process, so they merge
+            # the snapshot here instead of via node._register_feed — the
+            # same counters, one channel per process, never both.
+            from tensorflowonspark_tpu import compilecache
+
+            out.update(compilecache.stats.counters_snapshot())
+        except Exception:  # pragma: no cover - stripped envs
+            pass
         return out
 
     # -- network front ------------------------------------------------------

@@ -138,7 +138,17 @@ def serve_forever(args):
 
     telemetry.configure_from_meta({})
     telemetry.install_sigusr1()
-    server = serving.ModelServer(args.export_dir, args.max_batch)
+    if args.warm_cache_dir:
+        # Warm-start compile plane: persistent XLA cache + serialized
+        # bucket-rung executables under one root, so a restarted replica
+        # reaches first prediction in seconds with compile_count == 0.
+        # register_feed=False: gateway beats merge the counters themselves
+        # (heartbeat_metrics), there is no node heartbeat here.
+        from tensorflowonspark_tpu import compilecache
+
+        compilecache.configure(args.warm_cache_dir, register_feed=False)
+    server = serving.ModelServer(args.export_dir, args.max_batch,
+                                 warm_cache_dir=args.warm_cache_dir)
     gw = gateway.GatewayServer(
         server, host=args.host, port=args.port,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
@@ -197,6 +207,12 @@ def main(argv=None):
     serve.add_argument("--task-index", type=int, default=0, dest="task_index")
     serve.add_argument("--heartbeat", type=float, default=1.0,
                        help="roster heartbeat interval seconds")
+    serve.add_argument("--warm-cache-dir", default=None,
+                       dest="warm_cache_dir",
+                       help="warm-start root: persistent XLA compile cache "
+                            "+ serialized bucket-rung executables; a "
+                            "replica restart then warms by deserializing "
+                            "(compile_count stays 0)")
     args = parser.parse_args(argv)
 
     if args.serve:

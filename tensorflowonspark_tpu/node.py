@@ -635,6 +635,15 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
 
         def wrapper_fn(args, context):
             """Invoke the user fn with argv semantics (reference TFSparkNode.py:320-324)."""
+            # Warm-start compile plane: runs in the process that actually
+            # compiles (the forked background child in SPARK mode, this
+            # process in FILES mode), BEFORE the user fn touches jax —
+            # replacement nodes re-enter through this same closure, which
+            # is what makes warm rejoin automatic.  No-op without a
+            # configured cache dir.
+            from tensorflowonspark_tpu import compilecache
+
+            compilecache.configure_from_meta(cluster_meta)
             if isinstance(args, list):
                 sys.argv = args
             fn(args, context)

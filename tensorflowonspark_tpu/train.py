@@ -128,13 +128,24 @@ class Trainer(object):
         (auxes are not averaged — they may be arbitrary pytrees), so
         aux-derived metrics like accuracy sample 1/accum_steps of the
         batch; the loss itself IS the full-batch value.
+      aot_cache: warm-start executable store — a directory path or a
+        :class:`~tensorflowonspark_tpu.compilecache.AOTCache`.  The step /
+        multi-step / repeat-scan programs are resolved through it: a
+        fingerprint-matched serialized executable dispatches WITHOUT ever
+        tracing (second-scale elastic rejoin); a cold store compiles once
+        and persists for the next restart; any mismatch falls back to
+        plain JIT.  Scope the directory per model run — fingerprints
+        cover versions/mesh/avals, not the loss closure (see
+        :mod:`~tensorflowonspark_tpu.compilecache`).
+        :func:`fit_supervised` defaults it beside the checkpoint root.
     """
 
     def __init__(self, loss_fn, init_params, optimizer, mesh=None,
                  extra_state=None, compute_dtype=None, batch_size=None,
                  log_steps=20, donate=True, accum_steps=1,
                  summary_writer=None, param_sharding=None,
-                 extra_step_flops=0, step_flops_override=None):
+                 extra_step_flops=0, step_flops_override=None,
+                 aot_cache=None):
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh()
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -290,6 +301,15 @@ class Trainer(object):
         self._train_step = jax.jit(self._step_core,
                                    donate_argnums=self._donate)
         self._multi_cache = {}  # k -> jitted k-step scan program
+        # Warm-start compile plane (compilecache): the AOT executable
+        # store, the per-program resolution memo (name -> deserialized /
+        # explicitly compiled executable, or None = plain jit), and the
+        # load-vs-compile verdicts for status reporting.
+        self._aot = None
+        self._aot_exec = {}
+        self._aot_verdicts = {}
+        if aot_cache is not None:
+            self.set_aot_cache(aot_cache)
         self._eval_cache = {}   # metric_fn -> jitted wrapper (evaluate)
         self.history = None
         # Always-on dispatch-overlap tallies (plain ints, the DataFeed
@@ -565,6 +585,62 @@ class Trainer(object):
                 repeat, donate_argnums=self._donate)
         return self._multi_cache[key]
 
+    def set_aot_cache(self, cache):
+        """Attach a warm-start AOT executable store (a directory path or
+        :class:`~tensorflowonspark_tpu.compilecache.AOTCache`).  No-op when
+        one is already attached, so :func:`fit_supervised` can default the
+        store beside the checkpoint root without clobbering an explicit
+        ctor choice."""
+        if self._aot is not None or cache is None:
+            return
+        from tensorflowonspark_tpu import compilecache
+
+        self._aot = (cache if isinstance(cache, compilecache.AOTCache)
+                     else compilecache.AOTCache(cache))
+
+    def _aot_resolve(self, name, jit_fn, args):
+        """Dispatchable executable for program ``name``, or None (plain jit
+        dispatch).  First call per name decides: a fingerprint-matched
+        artifact deserializes and dispatches without ever tracing (the
+        warm-rejoin path); a cold store lowers+compiles once and persists
+        the executable for the next restart; no store / unsupported
+        serialization memoizes None.  Shape drift after resolution is
+        handled at dispatch (see :meth:`step`)."""
+        if self._aot is None:
+            return None
+        if name in self._aot_exec:
+            return self._aot_exec[name]
+        from tensorflowonspark_tpu import compilecache
+
+        fp = compilecache.fingerprint(
+            avals=args, mesh=self.mesh, donate=self._donate,
+            extra={"program": name, "accum_steps": self.accum_steps,
+                   "compute_dtype": str(self.compute_dtype)})
+        compiled, verdict, micros = compilecache.load_or_compile(
+            self._aot, name, fp, jit_fn, args)
+        self._aot_verdicts[name] = verdict
+        logger.info("AOT program %s: %s (%.1f ms)", name, verdict,
+                    micros / 1e3)
+        self._aot_exec[name] = compiled
+        return compiled
+
+    def _aot_dispatch(self, name, jit_fn, args):
+        """Run ``name`` via its resolved executable, falling back to the
+        jit fn — permanently for this program name — if the shape-locked
+        executable rejects the call (e.g. an odd tail batch after
+        resolution).  The rejection raises before execution, so donated
+        buffers are still intact for the retry."""
+        fn = self._aot_resolve(name, jit_fn, args)
+        if fn is not None:
+            try:
+                return fn(*args)
+            except TypeError:
+                logger.warning(
+                    "AOT executable %s rejected the call (aval drift); "
+                    "reverting this program to JIT dispatch", name)
+                self._aot_exec[name] = None
+        return jit_fn(*args)
+
     def _ensure_history(self, example_batch, example_mask, stacked=False):
         """Lazily build the metrics recorder with per-step FLOPs.
 
@@ -620,7 +696,8 @@ class Trainer(object):
         per-step density."""
         fn = self._get_repeat_step(k)
         self._ensure_history(batch, mask)
-        self.state, (losses, final) = fn(self.state, batch, mask)
+        self.state, (losses, final) = self._aot_dispatch(
+            "repeat_%d" % k, fn, (self.state, batch, mask))
         self.history.on_steps_end(k, losses)
         return final
 
@@ -634,7 +711,8 @@ class Trainer(object):
         k = int(jax.tree_util.tree_leaves(masks)[0].shape[0])
         fn = self._get_multi_step(k)
         self._ensure_history(batches, masks, stacked=True)
-        self.state, (losses, final) = fn(self.state, batches, masks)
+        self.state, (losses, final) = self._aot_dispatch(
+            "multi_%d" % k, fn, (self.state, batches, masks))
         self.history.on_steps_end(k, losses)
         return final
 
@@ -713,7 +791,8 @@ class Trainer(object):
             first = jax.tree_util.tree_leaves(batch)[0]
             mask = jnp.ones((first.shape[0],), jnp.float32)
         self._ensure_history(batch, mask)
-        self.state, loss, packed = self._train_step(self.state, batch, mask)
+        self.state, loss, packed = self._aot_dispatch(
+            "step", self._train_step, (self.state, batch, mask))
         # apply_update rides the grad norm out next to the user aux; keep
         # it as an un-synced device scalar until a window boundary reads it
         # (multi_step's scan discards aux, so the gauge follows single-step
@@ -882,6 +961,15 @@ class Trainer(object):
         state, step = restore(ckpt_mod.abstract_state(self.state))
         if step is None:
             return None
+        if self._aot is not None and self._donate:
+            # Donating checkpoint-restored buffers into a DESERIALIZED
+            # executable corrupts the heap (jaxlib 0.4.37, multi-device CPU:
+            # the restore path's externally-owned buffers double-free under
+            # donation; an in-process-compiled executable tolerates them).
+            # An identity jit rewrites the state into fresh runtime-owned
+            # buffers — one device-to-device copy, same shardings, paid only
+            # on the restore-then-warm-rejoin path that hits the bug.
+            state = jax.jit(lambda t: t)(state)
         self.state = state
         logger.info("trainer state restored at step %d", step)
         return step
@@ -921,6 +1009,19 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
 
     policy = retry_policy or fault_mod.RetryPolicy()
     tracer = telemetry.get_tracer()
+
+    # Warm-start default: the AOT executable store lives beside the
+    # checkpoints, so a restarted/replacement supervisor that can see the
+    # checkpoint root can also see the serialized executables (restore and
+    # warm rejoin share one directory tree).  set_aot_cache is a no-op
+    # when the Trainer ctor already chose a store.
+    from tensorflowonspark_tpu import checkpoint as ckpt_mod
+
+    try:
+        trainer.set_aot_cache(ckpt_mod.aot_root(ckpt_manager.directory))
+    except OSError as e:  # read-only roots: warm start is optional
+        logger.warning("AOT store beside checkpoints unavailable (%s); "
+                       "training proceeds with plain JIT", e)
 
     def _emergency_save():
         # Preemption drain: land whatever progress exists before the process

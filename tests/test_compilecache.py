@@ -1,0 +1,292 @@
+"""Warm-start compile plane tests: fingerprint gating, corruption
+tolerance, trainer/serving AOT round trips (CPU mesh).
+
+The invariant under test everywhere: a warm start is an optimization,
+never a correctness dependency — every mismatched, corrupt, or drifted
+artifact must degrade to plain JIT with ``compile_cache_fallback``
+incremented, identical numerics, and no exception.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensorflowonspark_tpu import checkpoint, compilecache, serving
+from tensorflowonspark_tpu.models import get_model
+from tensorflowonspark_tpu.parallel import build_mesh
+from tensorflowonspark_tpu.train import Trainer
+
+
+def _loss(params, batch, mask):
+    pred = batch["x"] @ params["w"]
+    err = (pred - batch["y"]) ** 2 * mask
+    return err.sum() / jnp.maximum(mask.sum(), 1.0), pred
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 2).astype(np.float32)
+    return {"x": jnp.asarray(x), "y": jnp.asarray(x @ [1.0, -1.0])}
+
+
+def _fresh_trainer(cache_dir, batch_size=8):
+    return Trainer(_loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                   batch_size=batch_size, log_steps=1000,
+                   aot_cache=cache_dir)
+
+
+class TestAOTStore:
+    def test_cold_then_warm_roundtrip(self, tmp_path):
+        """Cold store compiles + persists; a second process-equivalent
+        (fresh AOTCache over the same dir) loads without tracing and
+        computes the same numbers."""
+        cache = compilecache.AOTCache(str(tmp_path))
+        fn = jax.jit(lambda x: x * 2 + 1)
+        args = (jnp.arange(4, dtype=jnp.float32),)
+        fp = compilecache.fingerprint(avals=args, extra={"program": "t"})
+
+        before = compilecache.stats.aot_save
+        compiled, verdict, _ = compilecache.load_or_compile(
+            cache, "t", fp, fn, args)
+        assert verdict == "compiled"
+        assert compilecache.stats.aot_save == before + 1
+        assert os.path.exists(cache.path("t"))
+
+        warm = compilecache.AOTCache(str(tmp_path))
+        loaded, verdict2, _ = compilecache.load_or_compile(
+            warm, "t", fp, fn, args)
+        assert verdict2 == "loaded"
+        np.testing.assert_allclose(np.asarray(loaded(*args)),
+                                   np.asarray(compiled(*args)))
+
+    def test_absent_artifact_is_silent_miss(self, tmp_path):
+        """A cold store is not a fallback: the counter must not move."""
+        cache = compilecache.AOTCache(str(tmp_path))
+        before = compilecache.stats.fallback
+        assert cache.load("nope", {"format": 1}) is None
+        assert compilecache.stats.fallback == before
+
+    def test_aval_mismatch_falls_back(self, tmp_path):
+        """Same program name, different batch aval -> the stored artifact
+        is rejected (diff names 'avals') and the caller recompiles."""
+        cache = compilecache.AOTCache(str(tmp_path))
+        fn = jax.jit(lambda x: x.sum())
+        small = (jnp.zeros((4,), jnp.float32),)
+        big = (jnp.zeros((16,), jnp.float32),)
+        fp_small = compilecache.fingerprint(avals=small)
+        fp_big = compilecache.fingerprint(avals=big)
+        assert fp_small != fp_big
+
+        compilecache.load_or_compile(cache, "p", fp_small, fn, small)
+        before = compilecache.stats.fallback
+        compiled, verdict, _ = compilecache.load_or_compile(
+            cache, "p", fp_big, fn, big)
+        assert verdict == "compiled"          # clean recompile, no crash
+        assert compilecache.stats.fallback == before + 1
+        assert float(compiled(*big)) == 0.0
+
+    def test_jaxlib_version_drift_falls_back(self, tmp_path):
+        """An artifact from a different jaxlib must never deserialize:
+        rewrite the stored fingerprint to a fabricated version and assert
+        the load path rejects it BEFORE touching the payload."""
+        cache = compilecache.AOTCache(str(tmp_path))
+        fn = jax.jit(lambda x: x + 1)
+        args = (jnp.zeros((2,), jnp.float32),)
+        fp = compilecache.fingerprint(avals=args)
+        compilecache.load_or_compile(cache, "v", fp, fn, args)
+
+        with open(cache.path("v"), "rb") as f:
+            doc = pickle.load(f)
+        doc["fingerprint"] = dict(doc["fingerprint"], jaxlib="9.9.9-fake")
+        with open(cache.path("v"), "wb") as f:
+            pickle.dump(doc, f)
+
+        before = compilecache.stats.fallback
+        assert cache.load("v", fp) is None
+        assert compilecache.stats.fallback == before + 1
+
+    @pytest.mark.parametrize("poison", [b"", b"not a pickle",
+                                        b"\x80\x04garbage"])
+    def test_corrupt_artifact_falls_back(self, tmp_path, poison):
+        cache = compilecache.AOTCache(str(tmp_path))
+        with open(cache.path("c"), "wb") as f:
+            f.write(poison)
+        before = compilecache.stats.fallback
+        assert cache.load("c", compilecache.fingerprint()) is None
+        assert compilecache.stats.fallback == before + 1
+
+    def test_truncated_artifact_falls_back(self, tmp_path):
+        """A real artifact cut mid-payload (the torn-write shape the
+        atomic rename prevents, simulated anyway) reads as corrupt."""
+        cache = compilecache.AOTCache(str(tmp_path))
+        fn = jax.jit(lambda x: x * 3)
+        args = (jnp.zeros((2,), jnp.float32),)
+        fp = compilecache.fingerprint(avals=args)
+        compilecache.load_or_compile(cache, "t", fp, fn, args)
+        with open(cache.path("t"), "rb") as f:
+            blob = f.read()
+        with open(cache.path("t"), "wb") as f:
+            f.write(blob[:len(blob) // 3])
+        before = compilecache.stats.fallback
+        assert cache.load("t", fp) is None
+        assert compilecache.stats.fallback == before + 1
+
+
+class TestTrainerAOT:
+    def test_warm_trainer_loads_and_matches(self, tmp_path):
+        """Two trainers over one store: the first compiles, the second
+        loads — and N steps land on bit-identical weights."""
+        cache_dir = str(tmp_path / "aot")
+        cold = _fresh_trainer(cache_dir)
+        warm = _fresh_trainer(cache_dir)
+        for step in range(5):
+            cold.step(_batch(seed=step))
+        assert cold._aot_verdicts.get("step") == "compiled"
+        for step in range(5):
+            warm.step(_batch(seed=step))
+        assert warm._aot_verdicts.get("step") == "loaded"
+        np.testing.assert_array_equal(np.asarray(cold.state.params["w"]),
+                                      np.asarray(warm.state.params["w"]))
+
+    def test_restored_state_survives_donated_warm_dispatch(self, tmp_path):
+        """The warm-rejoin path proper: checkpoint-restored state donated
+        into a DESERIALIZED executable.  Restored buffers are externally
+        owned (orbax/tensorstore) and double-free under donation on a
+        multi-device CPU mesh (jaxlib 0.4.37) — restore_latest must rewrite
+        them into runtime-owned buffers before the loaded program runs."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        mesh = build_mesh()
+        sh = NamedSharding(mesh, PartitionSpec("data"))
+
+        def sharded_batch(seed):
+            rng = np.random.RandomState(seed)
+            mk = jax.make_array_from_process_local_data
+            x = rng.rand(8, 2).astype(np.float32)
+            return {"x": mk(sh, x), "y": mk(sh, x @ np.asarray([1.0, -1.0],
+                                                               np.float32))}
+
+        def trainer():
+            return Trainer(_loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                           mesh=mesh, batch_size=8, log_steps=1000,
+                           aot_cache=str(tmp_path / "aot"), donate=True)
+
+        ckpt = checkpoint.CheckpointManager(str(tmp_path / "ckpt"),
+                                            save_interval_steps=100)
+        try:
+            cold = trainer()
+            cold.step(sharded_batch(0))
+            cold.step(sharded_batch(1))
+            ckpt.maybe_save(int(cold.state.step), cold.state, force=True)
+            ckpt.wait_until_finished()
+
+            warm = trainer()
+            assert warm.restore_latest(ckpt, validate=True) == 2
+            # several donated dispatches: the heap corruption (when present)
+            # surfaces within the first few frees, as a hard crash
+            for step in range(6):
+                loss, _ = warm.step(sharded_batch(step))
+            assert warm._aot_verdicts.get("step") == "loaded"
+            assert np.isfinite(float(loss))
+            assert int(warm.state.step) == 8
+        finally:
+            ckpt.close()
+
+    def test_mesh_shape_in_fingerprint(self, tmp_path):
+        """A trainer on a different mesh must not load the artifact —
+        its fingerprint carries the (axis, extent) layout."""
+        mesh1 = build_mesh()                      # all 8 virtual devices
+        fp1 = compilecache.fingerprint(mesh=mesh1)
+        fp2 = compilecache.fingerprint(mesh=None)
+        assert fp1 != fp2
+        devs = np.asarray(jax.devices()[:4]).reshape(2, 2)
+        mesh3 = jax.sharding.Mesh(devs, ("data", "model"))
+        assert (compilecache.fingerprint(mesh=mesh3)["mesh"]
+                != fp1["mesh"])
+
+    def test_aval_drift_reverts_program_to_jit(self, tmp_path):
+        """An AOT executable resolved for one batch shape must not poison
+        a later call with another: the dispatch catches the executable's
+        aval rejection and permanently reverts that program to JIT."""
+        tr = _fresh_trainer(str(tmp_path / "aot"), batch_size=8)
+        tr.step(_batch(n=8))
+        assert tr._aot_exec.get("step") is not None
+        loss, _ = tr.step(_batch(n=4))            # drifted aval: no crash
+        assert np.isfinite(float(loss))
+        assert tr._aot_exec.get("step") is None   # reverted for good
+
+    def test_trainer_without_store_unchanged(self):
+        tr = Trainer(_loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                     batch_size=8, log_steps=1000)
+        loss, _ = tr.step(_batch())
+        assert np.isfinite(float(loss))
+        assert tr._aot_verdicts == {}
+
+
+class TestServingAOT:
+    def test_warm_restart_zero_compiles(self, tmp_path):
+        """A replica restart over the warm dir must reach first
+        prediction with compile_count == 0 and identical outputs."""
+        params = {"dense": {"kernel": np.asarray([[2.0], [3.0]], np.float32),
+                            "bias": np.zeros((1,), np.float32)}}
+        export_dir = str(tmp_path / "export")
+        checkpoint.export_model(export_dir, params, "linear",
+                                model_config={"features": 1},
+                                input_signature={"x": [None, 2]},
+                                model=get_model("linear"))
+        warm_dir = str(tmp_path / "warm")
+
+        cold = serving.ModelServer(export_dir, batch_size=4,
+                                   warm_cache_dir=warm_dir)
+        cold.warmup()
+        assert cold.warmup_report["compiled"] > 0
+        cold_out = cold.predict_feed({"x": np.ones((2, 2), np.float32)}, 4)
+
+        warm = serving.ModelServer(export_dir, batch_size=4,
+                                   warm_cache_dir=warm_dir)
+        warm.warmup()
+        assert warm.compile_count == 0
+        assert warm.warmup_report["loaded"] == cold.warmup_report["compiled"]
+        warm_out = warm.predict_feed({"x": np.ones((2, 2), np.float32)}, 4)
+        np.testing.assert_allclose(np.asarray(warm_out["output"]),
+                                   np.asarray(cold_out["output"]))
+
+    def test_cacheless_server_unchanged(self, tmp_path):
+        params = {"dense": {"kernel": np.ones((2, 1), np.float32),
+                            "bias": np.zeros((1,), np.float32)}}
+        export_dir = str(tmp_path / "export")
+        checkpoint.export_model(export_dir, params, "linear",
+                                model_config={"features": 1},
+                                input_signature={"x": [None, 2]},
+                                model=get_model("linear"))
+        server = serving.ModelServer(export_dir, batch_size=4)
+        server.warmup()
+        assert server.compile_count > 0
+        assert server.warmup_report["loaded"] == 0
+
+
+class TestConfigure:
+    def test_inert_without_dir(self, monkeypatch):
+        monkeypatch.delenv(compilecache.CACHE_DIR_ENV, raising=False)
+        assert compilecache.configure(None, register_feed=False) is None
+
+    def test_counters_snapshot_shape(self):
+        snap = compilecache.stats.counters_snapshot()
+        assert set(snap) >= {"compile_cache_hit", "compile_cache_miss",
+                             "compile_cache_fallback",
+                             "compile_cache_aot_load",
+                             "compile_cache_aot_save",
+                             "compile_cache_dir_bytes_hwm"}
+        assert all(isinstance(v, int) for v in snap.values())
+
+    def test_fingerprint_names_the_diverged_field(self):
+        a = compilecache.fingerprint(extra={"program": "x"})
+        b = compilecache.fingerprint(extra={"program": "y"})
+        diff = sorted(k for k in set(a) | set(b) if a.get(k) != b.get(k))
+        assert diff == ["program"]

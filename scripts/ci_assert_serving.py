@@ -38,6 +38,7 @@ BUDGET_SECS = 60.0
 N_CLIENTS = 4
 REQS_PER_CLIENT = 60
 KILL_AFTER = 20          # per-client requests before the SIGKILL lands
+MAX_BATCH = 8            # replica --max-batch; fixes the bucket ladder
 
 
 def _spawn_replica(roster_addr, replica_id, task_index, export_dir,
@@ -49,7 +50,8 @@ def _spawn_replica(roster_addr, replica_id, task_index, export_dir,
            "--export_dir", export_dir, "--serve", "--port", "0",
            "--roster", "{}:{}".format(*roster_addr),
            "--replica-id", replica_id, "--task-index", str(task_index),
-           "--max-batch", "8", "--max-wait-ms", "5", "--heartbeat", "0.25"]
+           "--max-batch", str(MAX_BATCH), "--max-wait-ms", "5",
+           "--heartbeat", "0.25"]
     if warm_dir:
         cmd += ["--warm-cache-dir", warm_dir]
     return subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
@@ -95,22 +97,25 @@ def main():
     base = "http://{}:{}".format(*obs.addr)
 
     # both replicas share one warm-start root: the first persists each
-    # bucket rung's serialized executable, the second (spawned once the
-    # first's artifacts stop appearing — the restarted-replica shape)
-    # deserializes instead of compiling
+    # bucket rung's serialized executable, the second (spawned once every
+    # rung's artifact exists — the restarted-replica shape) deserializes
+    # instead of compiling.  Readiness is the exact ladder length, not a
+    # stability window: warmup writes one artifact per rung, and a slow
+    # host's inter-rung compile gap must not fake completion.
+    from tensorflowonspark_tpu import serving
+
+    expected_rungs = len(serving.bucket_ladder(MAX_BATCH))
     warm_dir = os.path.join(tmp, "warm")
     procs = [_spawn_replica(roster_addr, "ci-s0", 0, export_dir, warm_dir)]
     deadline = time.time() + BUDGET_SECS / 2
-    seen, stable_since = -1, time.time()
     while True:
         n = (len([f for f in os.listdir(warm_dir) if f.endswith(".aotx")])
              if os.path.isdir(warm_dir) else 0)
-        if n != seen:
-            seen, stable_since = n, time.time()
-        elif n > 0 and time.time() - stable_since > 1.0:
+        if n >= expected_rungs:
             break
         assert time.time() < deadline, \
-            "first replica never persisted a warm rung artifact"
+            "first replica persisted {}/{} warm rung artifacts".format(
+                n, expected_rungs)
         time.sleep(0.1)
     procs.append(_spawn_replica(roster_addr, "ci-s1", 1, export_dir,
                                 warm_dir))

@@ -28,11 +28,25 @@ amortization argument, arXiv:2101.12127), on two tiers:
    dependency.
 
 Scoping contract: fingerprints cover everything jax can see (versions,
-devices, mesh, donation, avals) but NOT the Python closure being compiled
-— two different models with identical aval signatures would collide on the
-same store.  Callers therefore scope the store directory per model run
-(the trainer defaults it beside the checkpoint root, see
-``checkpoint.aot_root``; serving keys by export dir).
+devices, mesh, donation, avals) plus whatever program identity the caller
+mixes in — the trainer hashes its loss fn + optimizer structurally
+(:func:`program_identity`) so resuming a run after editing the loss or
+hyperparameters rejects the stale executable; serving keys by model
+name/config.  The structural hash is best-effort (bytecode + consts +
+closure values), so callers should still scope the store directory per
+model run (the trainer defaults it beside the checkpoint root, see
+``checkpoint.aot_root``; serving keys by export dir) and can pin an
+explicit ``program_version`` when the automatic hash can't see a change.
+
+Trust boundary: artifacts carry a ``jax.experimental.serialize_executable``
+payload that is ultimately unpickled on load — anyone with WRITE access to
+a store directory can execute arbitrary code in every process that warms
+from it.  The store therefore (a) creates its directory ``0o700``, (b)
+verifies the plain-JSON fingerprint header *before* any ``pickle.loads``
+so mismatched artifacts never reach the unpickler, and (c) must live on a
+mount whose writers you trust exactly as much as the training job itself
+(same bar as the checkpoint root).  Remote object-store URLs are rejected
+— this store is local-filesystem / shared-mount only.
 """
 
 import logging
@@ -50,9 +64,14 @@ CACHE_DIR_ENV = "TFOS_COMPILE_CACHE_DIR"
 
 #: bump when the artifact layout changes — old artifacts then read as
 #: fingerprint mismatches (clean JIT fallback), not crashes
-_FORMAT = 1
+_FORMAT = 2
 
 _SUFFIX = ".aotx"
+
+#: artifact layout: magic, one line of canonical-JSON fingerprint, then
+#: the pickled executable triple.  The JSON header is what load() checks
+#: — only a fingerprint-matched artifact ever reaches pickle.
+_MAGIC = b"TFOS-AOTX2\n"
 
 # jax monitoring event names the counters are derived from (stable across
 # the jax versions this repo supports; unknown names just never fire).
@@ -308,19 +327,165 @@ def fingerprint(avals=None, mesh=None, donate=(), extra=None):
     return fp
 
 
+def _fp_canonical(fp):
+    """Canonical JSON form of a fingerprint dict — the representation
+    stored in the artifact header and compared on load (tuples coerce to
+    lists identically on both sides; non-JSON values go through repr)."""
+    import json
+
+    return json.dumps(fp, sort_keys=True, default=repr)
+
+
+def _identity_parts(obj, parts, seen, depth=0):
+    """Recursive structural walk feeding :func:`program_identity`.
+
+    Functions contribute bytecode, consts, names, defaults, and closure
+    cell VALUES (recursively — optax transforms are namedtuples of
+    closures, so hyperparameters like a learning rate live in cells);
+    arrays contribute shape/dtype plus a content digest when small;
+    containers and plain objects recurse sorted.  Anything opaque falls
+    back to its type name — a too-coarse hash only risks a spurious
+    mismatch, which degrades to a clean recompile, never a stale load."""
+    if depth > 12:
+        parts.append("<depth>")
+        return
+    if obj is None or isinstance(obj, (bool, int, float, complex, str,
+                                       bytes)):
+        parts.append(repr(obj))
+        return
+    if id(obj) in seen:
+        parts.append("<cycle>")
+        return
+    seen.add(id(obj))
+    import functools
+
+    if isinstance(obj, functools.partial):
+        parts.append("partial")
+        _identity_parts(obj.func, parts, seen, depth + 1)
+        for a in obj.args:
+            _identity_parts(a, parts, seen, depth + 1)
+        for k in sorted(obj.keywords or {}):
+            parts.append(repr(k))
+            _identity_parts(obj.keywords[k], parts, seen, depth + 1)
+        return
+    func = getattr(obj, "__func__", None)
+    if func is not None:                       # bound method
+        _identity_parts(func, parts, seen, depth + 1)
+        _identity_parts(getattr(obj, "__self__", None), parts, seen,
+                        depth + 1)
+        return
+    code = getattr(obj, "__code__", None)
+    if code is not None:                       # plain function / lambda
+        parts.append("fn:%s" % getattr(obj, "__qualname__", ""))
+        parts.append(code.co_code.hex())
+        parts.append(repr(code.co_names))
+        for c in code.co_consts:
+            if hasattr(c, "co_code"):          # nested function's code
+                parts.append(c.co_code.hex())
+            else:
+                parts.append(repr(c))
+        for cell in getattr(obj, "__closure__", None) or ():
+            try:
+                _identity_parts(cell.cell_contents, parts, seen, depth + 1)
+            except ValueError:                 # empty cell
+                parts.append("<empty-cell>")
+        for d in getattr(obj, "__defaults__", None) or ():
+            _identity_parts(d, parts, seen, depth + 1)
+        return
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):   # array-likes
+        shape = tuple(getattr(obj, "shape", ()))
+        parts.append("arr:%s:%s" % (obj.dtype, shape))
+        try:
+            import hashlib
+
+            import numpy as np
+
+            arr = np.asarray(obj)
+            if arr.size <= 4096:
+                parts.append(hashlib.sha256(arr.tobytes()).hexdigest())
+        except Exception:                      # non-addressable etc.
+            pass
+        return
+    if isinstance(obj, dict):
+        for k in sorted(obj, key=repr):
+            parts.append(repr(k))
+            _identity_parts(obj[k], parts, seen, depth + 1)
+        return
+    if isinstance(obj, (list, tuple)):         # incl. namedtuples (optax)
+        parts.append(type(obj).__name__)
+        for v in obj:
+            _identity_parts(v, parts, seen, depth + 1)
+        return
+    if isinstance(obj, (set, frozenset)):
+        for v in sorted(obj, key=repr):
+            _identity_parts(v, parts, seen, depth + 1)
+        return
+    parts.append(type(obj).__qualname__)
+    d = getattr(obj, "__dict__", None)
+    if isinstance(d, dict):
+        for k in sorted(d, key=repr):
+            parts.append(repr(k))
+            _identity_parts(d[k], parts, seen, depth + 1)
+
+
+def program_identity(*objs):
+    """Best-effort structural hash of the PYTHON half of a compiled
+    program — the part no aval fingerprint can see.
+
+    The trainer feeds its loss fn and optimizer through this and mixes
+    the digest into every AOT fingerprint, so resuming in the same
+    checkpoint dir after editing the loss or an optimizer hyperparameter
+    (same shapes, different program) rejects the stale serialized
+    executable and recompiles instead of silently training the old
+    program.  Best-effort by design: an over-sensitive hash (e.g. a
+    docstring edit) costs one recompile; only the caller can assert true
+    equivalence, via an explicit ``program_version``."""
+    import hashlib
+
+    parts = []
+    seen = set()
+    for obj in objs:
+        try:
+            _identity_parts(obj, parts, seen)
+        except Exception:                      # pragma: no cover - exotic
+            parts.append("<opaque:%s>" % type(obj).__name__)
+    return hashlib.sha256(
+        "|".join(parts).encode("utf-8", "backslashreplace")).hexdigest()
+
+
 class AOTCache(object):
     """Serialized-executable store: ``name`` -> one fingerprinted artifact.
 
-    Artifacts are pickle files (``<name>.aotx``) holding the fingerprint
-    dict plus the ``jax.experimental.serialize_executable`` triple
+    Artifacts are ``<name>.aotx`` files: :data:`_MAGIC`, one line of
+    canonical-JSON fingerprint, then the pickled
+    ``jax.experimental.serialize_executable`` triple
     ``(payload, in_tree, out_tree)``, written atomically (tmp + rename)
     so a killed writer can never leave a half artifact under a reader.
     Absent / mismatched / corrupt artifacts are all clean misses.
+
+    Trust boundary (see the module docstring): the executable payload is
+    unpickled on load, so the store directory must only be writable by
+    principals trusted to run code in the warming processes — it is
+    created ``0o700``, and the JSON header is verified BEFORE the payload
+    is ever unpickled.  Local filesystem / shared mount only: remote
+    object-store URLs raise (``fit_supervised`` skips auto-attaching the
+    store for remote checkpoint roots for the same reason).
     """
 
     def __init__(self, directory):
+        from tensorflowonspark_tpu import fsio
+
+        directory = fsio.strip_file_scheme(str(directory))
+        if fsio.is_remote(directory):
+            raise ValueError(
+                "AOTCache needs a local or shared-mount directory; remote "
+                "URL %r is not supported (artifacts are local files and "
+                "their executable payload is unpickled on load — see the "
+                "compilecache trust-boundary note)" % (directory,))
         self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
+        # 0o700 on creation: artifacts execute-by-deserialization in every
+        # process that warms from here (no-op for pre-existing dirs)
+        os.makedirs(self.directory, mode=0o700, exist_ok=True)
 
     def path(self, name):
         return os.path.join(self.directory, name + _SUFFIX)
@@ -334,6 +499,8 @@ class AOTCache(object):
         not a fallback)."""
         from tensorflowonspark_tpu import telemetry
 
+        import json
+
         path = self.path(name)
         if not os.path.exists(path):
             return None
@@ -342,8 +509,11 @@ class AOTCache(object):
         try:
             with open(path, "rb") as f:
                 blob = f.read()
-            doc = pickle.loads(blob)
-            stored = doc["fingerprint"]
+            if not blob.startswith(_MAGIC):
+                raise ValueError("bad magic")
+            header_end = blob.index(b"\n", len(_MAGIC))
+            stored = json.loads(blob[len(_MAGIC):header_end]
+                                .decode("utf-8"))
         except Exception as e:
             stats.fallback += 1
             logger.warning("AOT artifact %s unreadable (%s: %s); "
@@ -351,10 +521,13 @@ class AOTCache(object):
             tracer.instant("compile/jit_fallback", program=name,
                            reason="corrupt")
             return None
-        if stored != fp:
+        # fingerprint gate runs on the plain-JSON header — a mismatched
+        # artifact is rejected before its pickled payload is ever touched
+        expect = json.loads(_fp_canonical(fp))
+        if stored != expect:
             stats.fallback += 1
-            diff = sorted(k for k in set(stored) | set(fp)
-                          if stored.get(k) != fp.get(k))
+            diff = sorted(k for k in set(stored) | set(expect)
+                          if stored.get(k) != expect.get(k))
             logger.warning("AOT artifact %s fingerprint mismatch on %s; "
                            "falling back to JIT", path, diff)
             tracer.instant("compile/jit_fallback", program=name,
@@ -365,8 +538,9 @@ class AOTCache(object):
 
             import jax
 
+            payload, in_tree, out_tree = pickle.loads(blob[header_end + 1:])
             compiled = se.deserialize_and_load(
-                doc["payload"], doc["in_tree"], doc["out_tree"],
+                payload, in_tree, out_tree,
                 backend=jax.default_backend())
         except Exception as e:
             stats.fallback += 1
@@ -395,10 +569,9 @@ class AOTCache(object):
             from jax.experimental import serialize_executable as se
 
             payload, in_tree, out_tree = se.serialize(compiled)
-            blob = pickle.dumps(
-                {"fingerprint": fp, "payload": payload,
-                 "in_tree": in_tree, "out_tree": out_tree},
-                protocol=pickle.HIGHEST_PROTOCOL)
+            blob = (_MAGIC + _fp_canonical(fp).encode("utf-8") + b"\n"
+                    + pickle.dumps((payload, in_tree, out_tree),
+                                   protocol=pickle.HIGHEST_PROTOCOL))
         except Exception as e:
             logger.warning("AOT serialize of %s failed (%s: %s); "
                            "artifact skipped", name, type(e).__name__, e)

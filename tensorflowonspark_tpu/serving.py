@@ -606,7 +606,10 @@ class ModelServer(object):
                 avals=(self.params, feed),
                 extra={"program": name,
                        "stablehlo": self.from_stablehlo,
-                       "model": self.descriptor.get("model_name")})
+                       "model": self.descriptor.get("model_name"),
+                       "model_config": repr(sorted(
+                           (self.descriptor.get("model_config")
+                            or {}).items()))})
             compiled, verdict, _ = compilecache.load_or_compile(
                 self._aot, name, fp, self._predict, (self.params, feed))
             if compiled is not None:

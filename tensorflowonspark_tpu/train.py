@@ -134,10 +134,20 @@ class Trainer(object):
         fingerprint-matched serialized executable dispatches WITHOUT ever
         tracing (second-scale elastic rejoin); a cold store compiles once
         and persists for the next restart; any mismatch falls back to
-        plain JIT.  Scope the directory per model run — fingerprints
-        cover versions/mesh/avals, not the loss closure (see
-        :mod:`~tensorflowonspark_tpu.compilecache`).
-        :func:`fit_supervised` defaults it beside the checkpoint root.
+        plain JIT.  Fingerprints cover versions/mesh/avals PLUS a
+        structural hash of the loss fn + optimizer
+        (:func:`~tensorflowonspark_tpu.compilecache.program_identity`),
+        so resuming after editing the loss or a hyperparameter rejects
+        the stale executable; still scope the directory per model run
+        (see :mod:`~tensorflowonspark_tpu.compilecache`).
+        :func:`fit_supervised` defaults it beside a LOCAL checkpoint
+        root (remote roots skip the default — the store is
+        local-filesystem only).
+      aot_program_version: optional caller-asserted program identity mixed
+        into the AOT fingerprint VERBATIM.  The structural hash is
+        best-effort (bytecode + consts + closure values); bump this string
+        on any program change it cannot see — a mismatch is a clean
+        recompile, never a crash.
     """
 
     def __init__(self, loss_fn, init_params, optimizer, mesh=None,
@@ -145,7 +155,7 @@ class Trainer(object):
                  log_steps=20, donate=True, accum_steps=1,
                  summary_writer=None, param_sharding=None,
                  extra_step_flops=0, step_flops_override=None,
-                 aot_cache=None):
+                 aot_cache=None, aot_program_version=None):
         self.mesh = mesh if mesh is not None else mesh_mod.build_mesh()
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -308,6 +318,8 @@ class Trainer(object):
         self._aot = None
         self._aot_exec = {}
         self._aot_verdicts = {}
+        self._aot_program_version = aot_program_version
+        self._aot_program_id = None   # memoized program_identity digest
         if aot_cache is not None:
             self.set_aot_cache(aot_cache)
         self._eval_cache = {}   # metric_fn -> jitted wrapper (evaluate)
@@ -612,15 +624,33 @@ class Trainer(object):
             return self._aot_exec[name]
         from tensorflowonspark_tpu import compilecache
 
+        if self._aot_program_id is None:
+            # the Python half of the program — avals alone cannot tell two
+            # losses (or two learning rates) with identical shapes apart
+            self._aot_program_id = compilecache.program_identity(
+                self.loss_fn, self.optimizer)
         fp = compilecache.fingerprint(
             avals=args, mesh=self.mesh, donate=self._donate,
             extra={"program": name, "accum_steps": self.accum_steps,
-                   "compute_dtype": str(self.compute_dtype)})
+                   "compute_dtype": str(self.compute_dtype),
+                   "program_id": self._aot_program_id,
+                   "program_version": self._aot_program_version})
         compiled, verdict, micros = compilecache.load_or_compile(
             self._aot, name, fp, jit_fn, args)
         self._aot_verdicts[name] = verdict
-        logger.info("AOT program %s: %s (%.1f ms)", name, verdict,
-                    micros / 1e3)
+        if verdict == "loaded":
+            # loud on purpose: this dispatch runs a PRE-EXISTING serialized
+            # program (trace-free warm start) — the fingerprint vouches for
+            # versions/mesh/avals/program-identity, the operator should
+            # still see which store it came from
+            logger.warning(
+                "AOT program %s: loaded serialized executable from %s "
+                "(%.1f ms, trace-free; program_id %s)", name,
+                self._aot.directory, micros / 1e3,
+                self._aot_program_id[:12])
+        else:
+            logger.info("AOT program %s: %s (%.1f ms)", name, verdict,
+                        micros / 1e3)
         self._aot_exec[name] = compiled
         return compiled
 
@@ -629,12 +659,14 @@ class Trainer(object):
         jit fn — permanently for this program name — if the shape-locked
         executable rejects the call (e.g. an odd tail batch after
         resolution).  The rejection raises before execution, so donated
-        buffers are still intact for the retry."""
+        buffers are still intact for the retry — jax raises TypeError for
+        aval mismatches and ValueError for sharding/layout mismatches
+        (version-dependent), both from pre-execution argument checks."""
         fn = self._aot_resolve(name, jit_fn, args)
         if fn is not None:
             try:
                 return fn(*args)
-            except TypeError:
+            except (TypeError, ValueError):
                 logger.warning(
                     "AOT executable %s rejected the call (aval drift); "
                     "reverting this program to JIT dispatch", name)
@@ -1014,14 +1046,24 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
     # checkpoints, so a restarted/replacement supervisor that can see the
     # checkpoint root can also see the serialized executables (restore and
     # warm rejoin share one directory tree).  set_aot_cache is a no-op
-    # when the Trainer ctor already chose a store.
+    # when the Trainer ctor already chose a store.  Remote roots (gs://
+    # etc.) skip the default: AOTCache is local-filesystem only, and a
+    # store silently landing on node-local disk would LOOK shared while
+    # never actually warming a rejoining node.
     from tensorflowonspark_tpu import checkpoint as ckpt_mod
+    from tensorflowonspark_tpu import fsio
 
-    try:
-        trainer.set_aot_cache(ckpt_mod.aot_root(ckpt_manager.directory))
-    except OSError as e:  # read-only roots: warm start is optional
-        logger.warning("AOT store beside checkpoints unavailable (%s); "
-                       "training proceeds with plain JIT", e)
+    if fsio.is_remote(ckpt_manager.directory):
+        logger.info(
+            "checkpoint root %s is remote; warm-start AOT store not "
+            "auto-attached (pass Trainer(aot_cache=<shared local mount>) "
+            "to opt in)", ckpt_manager.directory)
+    else:
+        try:
+            trainer.set_aot_cache(ckpt_mod.aot_root(ckpt_manager.directory))
+        except (OSError, ValueError) as e:  # read-only roots: optional
+            logger.warning("AOT store beside checkpoints unavailable (%s); "
+                           "training proceeds with plain JIT", e)
 
     def _emergency_save():
         # Preemption drain: land whatever progress exists before the process

@@ -7,8 +7,8 @@ artifact must degrade to plain JIT with ``compile_cache_fallback``
 incremented, identical numerics, and no exception.
 """
 
+import json
 import os
-import pickle
 
 import numpy as np
 import pytest
@@ -93,8 +93,9 @@ class TestAOTStore:
 
     def test_jaxlib_version_drift_falls_back(self, tmp_path):
         """An artifact from a different jaxlib must never deserialize:
-        rewrite the stored fingerprint to a fabricated version and assert
-        the load path rejects it BEFORE touching the payload."""
+        rewrite the stored JSON fingerprint header to a fabricated version
+        — and replace the pickled payload with garbage, proving the load
+        path rejects on the header BEFORE touching the payload."""
         cache = compilecache.AOTCache(str(tmp_path))
         fn = jax.jit(lambda x: x + 1)
         args = (jnp.zeros((2,), jnp.float32),)
@@ -102,14 +103,44 @@ class TestAOTStore:
         compilecache.load_or_compile(cache, "v", fp, fn, args)
 
         with open(cache.path("v"), "rb") as f:
-            doc = pickle.load(f)
-        doc["fingerprint"] = dict(doc["fingerprint"], jaxlib="9.9.9-fake")
+            blob = f.read()
+        magic = compilecache._MAGIC
+        header_end = blob.index(b"\n", len(magic))
+        doc = json.loads(blob[len(magic):header_end])
+        doc["jaxlib"] = "9.9.9-fake"
         with open(cache.path("v"), "wb") as f:
-            pickle.dump(doc, f)
+            f.write(magic + json.dumps(doc, sort_keys=True).encode()
+                    + b"\n" + b"\x80\x04 not a pickle at all")
 
         before = compilecache.stats.fallback
         assert cache.load("v", fp) is None
         assert compilecache.stats.fallback == before + 1
+
+    def test_remote_directory_rejected(self):
+        """The store is local-filesystem only: a remote URL must raise
+        instead of being abspath-mangled into a bogus local dir (which
+        would LOOK shared while never warming another node)."""
+        with pytest.raises(ValueError, match="remote"):
+            compilecache.AOTCache("gs://bucket/ckpt/aot_executables")
+
+    def test_program_identity_sees_closure_values(self):
+        """The structural hash must separate programs an aval fingerprint
+        cannot: a different constant in the loss body, and a different
+        optimizer hyperparameter."""
+        def loss_a(params, batch, mask):
+            return (params * 2.0).sum(), None
+
+        def loss_b(params, batch, mask):
+            return (params * 3.0).sum(), None
+
+        assert (compilecache.program_identity(loss_a)
+                != compilecache.program_identity(loss_b))
+        assert (compilecache.program_identity(optax.sgd(0.1))
+                != compilecache.program_identity(optax.sgd(0.2)))
+        # deterministic across equivalent reconstructions (what two
+        # processes re-running the same code must agree on)
+        assert (compilecache.program_identity(optax.sgd(0.1))
+                == compilecache.program_identity(optax.sgd(0.1)))
 
     @pytest.mark.parametrize("poison", [b"", b"not a pickle",
                                         b"\x80\x04garbage"])
@@ -220,6 +251,56 @@ class TestTrainerAOT:
         loss, _ = tr.step(_batch(n=4))            # drifted aval: no crash
         assert np.isfinite(float(loss))
         assert tr._aot_exec.get("step") is None   # reverted for good
+
+    def test_changed_optimizer_rejects_stale_executable(self, tmp_path):
+        """The REVIEW.md stale-resume trap: same shapes, same store, but a
+        different learning rate — the resumed trainer must NOT load the
+        old serialized step program; it recompiles (fallback counted)."""
+        cache_dir = str(tmp_path / "aot")
+        cold = Trainer(_loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                       batch_size=8, log_steps=1000, aot_cache=cache_dir)
+        cold.step(_batch())
+        assert cold._aot_verdicts.get("step") == "compiled"
+
+        before = compilecache.stats.fallback
+        resumed = Trainer(_loss, {"w": jnp.zeros((2,))}, optax.sgd(0.05),
+                          batch_size=8, log_steps=1000, aot_cache=cache_dir)
+        resumed.step(_batch())
+        assert resumed._aot_verdicts.get("step") == "compiled"
+        assert compilecache.stats.fallback == before + 1
+
+    def test_changed_loss_rejects_stale_executable(self, tmp_path):
+        """Same shapes, edited loss body -> fingerprint mismatch on
+        program_id, clean recompile with correct numerics."""
+        def loss_v2(params, batch, mask):
+            pred = batch["x"] @ params["w"]
+            err = jnp.abs(pred - batch["y"]) * mask       # L1, not L2
+            return err.sum() / jnp.maximum(mask.sum(), 1.0), pred
+
+        cache_dir = str(tmp_path / "aot")
+        _fresh_trainer(cache_dir).step(_batch())
+        resumed = Trainer(loss_v2, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                          batch_size=8, log_steps=1000, aot_cache=cache_dir)
+        loss, _ = resumed.step(_batch())
+        assert resumed._aot_verdicts.get("step") == "compiled"
+        assert np.isfinite(float(loss))
+
+    def test_program_version_gates_load(self, tmp_path):
+        """An explicit aot_program_version is part of the fingerprint:
+        same code, bumped version -> no load."""
+        cache_dir = str(tmp_path / "aot")
+        kw = dict(batch_size=8, log_steps=1000, aot_cache=cache_dir)
+        v1 = Trainer(_loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                     aot_program_version="v1", **kw)
+        v1.step(_batch())
+        v2 = Trainer(_loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                     aot_program_version="v2", **kw)
+        v2.step(_batch())
+        assert v2._aot_verdicts.get("step") == "compiled"
+        same = Trainer(_loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),
+                       aot_program_version="v2", **kw)
+        same.step(_batch())
+        assert same._aot_verdicts.get("step") == "loaded"
 
     def test_trainer_without_store_unchanged(self):
         tr = Trainer(_loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1),

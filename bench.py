@@ -46,6 +46,7 @@ import os
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -91,6 +92,7 @@ LM_STEPS_PER_CALL = int(os.environ.get("TFOS_BENCH_LM_SPC", 20))
 LEG_TIMEOUT_SECS = {"mnist": 1500, "resnet": 1800, "transformer": 1800,
                     "feedplane": 600, "ceiling": 120,
                     "dataservice_cached_epoch": 300,
+                    "shared_jobs": 300,
                     "serving_latency": 300,
                     "warm_start": 600}
 
@@ -660,6 +662,163 @@ def measure_dataservice_cached_epoch(n_splits=16, per_split=6000):
         disp.stop()
 
 
+def measure_shared_jobs(n_splits=12, per_split=4000):
+    """Multi-tenant tier: warm shared attach + the affinity A/B.
+
+    Phase 1 (cold solo): one consumer drains a 1-epoch DYNAMIC job over
+    jsonl splits against 2 cache-armed workers — the full read/json-decode
+    path, and it leaves every split's frames in a worker chunk cache.
+
+    Phase 2 (warm attach): a SECOND job over the same files on the same
+    (now warm) workers, drained by TWO consumers sharing one ledger — the
+    second run attaches to the first run's job (``attach=True``) and the
+    splits are dealt across both.  Cache replay plus the split read is
+    the late-attacher pitch: warm attach wall time vs the cold solo run.
+
+    Phase 3 (affinity A/B): two fresh dispatcher+worker stacks — one with
+    cache-affinity DYNAMIC scheduling, one plain FCFS — each running a
+    2-epoch DYNAMIC job.  Epoch 1 fills both workers' caches; epoch 2's
+    hand-outs either steer each split back to its cache holder (affinity)
+    or re-deal ~half to the cold peer (FCFS).  The epoch-2 rates are the
+    graded pair; the hit-rate tally (kept under BOTH settings) is the
+    explanation."""
+    from tensorflowonspark_tpu import data, dataservice
+
+    tmp = tempfile.mkdtemp()
+    rng = np.random.default_rng(13)
+    splits = []
+    for s in range(n_splits):
+        path = os.path.join(tmp, "split-{:03d}.jsonl".format(s))
+        with open(path, "w") as f:
+            for _ in range(per_split):
+                row = (rng.integers(0, 512, 128) / 256.0).tolist()
+                f.write(json.dumps(row) + "\n")
+        splits.append(path)
+    total = n_splits * per_split
+
+    def _stack(affinity=None):
+        disp = dataservice.DispatcherServer(heartbeat_interval=0.25,
+                                            heartbeat_misses=4,
+                                            host="127.0.0.1",
+                                            affinity=affinity)
+        addr = disp.start()
+        workers = [dataservice.FeedWorker(
+            addr, row_reader=data.jsonl_rows,
+            worker_id="bench-shared-{}".format(i), heartbeat_interval=0.25,
+            cache_bytes=256 << 20).start() for i in range(2)]
+        return disp, addr, workers
+
+    def _drain(feed, split_at=None):
+        t0 = time.time()
+        consumed, t_split = 0, None
+        while not feed.should_stop():
+            _, count = feed.next_batch_arrays(2048)
+            consumed += count
+            if (split_at is not None and t_split is None
+                    and consumed >= split_at):
+                t_split = time.time()
+        return consumed, time.time() - t0, (t_split - t0) if t_split else None
+
+    stats = {"n_splits": n_splits, "per_split": per_split}
+
+    # -- phases 1+2 share one stack: the solo run warms the caches the
+    # attached pair then replays
+    disp, addr, workers = _stack()
+    try:
+        feed = dataservice.ServiceFeed(
+            addr, splits, job_name="bench-solo",
+            mode=dataservice.SHARD_DYNAMIC, prefetch=4, timeout=120.0)
+        consumed, cold_secs, _ = _drain(feed)
+        feed.terminate()
+        if consumed != total:
+            raise RuntimeError("cold solo run consumed {} items, expected "
+                               "{}".format(consumed, total))
+        # the next heartbeat advertises the freshly cached splits
+        deadline = time.time() + 10
+        while sum(len(v) for v in disp._worker_cache.values()) < n_splits:
+            if time.time() > deadline:
+                raise RuntimeError("worker caches never advertised")
+            time.sleep(0.05)
+
+        feed_a = dataservice.ServiceFeed(
+            addr, splits, job_name="bench-shared",
+            mode=dataservice.SHARD_DYNAMIC, consumer_id="bench-a",
+            prefetch=4, timeout=120.0)
+        feed_a._ensure_started()
+        feed_b = dataservice.ServiceFeed(
+            addr, None, job_name="bench-shared", attach=True,
+            consumer_id="bench-b", prefetch=4, timeout=120.0)
+        counts = {}
+
+        def _consume(feed, key):
+            counts[key] = _drain(feed)[0]
+
+        t0 = time.time()
+        threads = [threading.Thread(target=_consume, args=(f, k))
+                   for f, k in ((feed_a, "a"), (feed_b, "b"))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+        warm_secs = time.time() - t0
+        snap_a = feed_a.counters_snapshot()
+        feed_a.terminate()
+        feed_b.terminate()
+        if counts.get("a", 0) + counts.get("b", 0) != total:
+            raise RuntimeError(
+                "warm shared run consumed {} items, expected {}".format(
+                    counts.get("a", 0) + counts.get("b", 0), total))
+        stats.update({
+            "shared_cold_solo_secs": round(cold_secs, 3),
+            "shared_warm_attach_secs": round(warm_secs, 3),
+            "shared_attach_speedup": round(cold_secs / max(warm_secs, 1e-9),
+                                           2),
+            "shared_warm_split": {"a": counts.get("a", 0),
+                                  "b": counts.get("b", 0)},
+            "shared_cache_hits": snap_a.get("dataservice_cache_hit", 0),
+        })
+    finally:
+        for w in workers:
+            w.stop()
+        disp.stop()
+
+    # -- phase 3: affinity on/off, each on a fresh (cold) stack
+    def _epoch2_run(affinity):
+        disp, addr, workers = _stack(affinity=affinity)
+        try:
+            feed = dataservice.ServiceFeed(
+                addr, splits, job_name="bench-aff",
+                mode=dataservice.SHARD_DYNAMIC, num_epochs=2, prefetch=4,
+                timeout=120.0)
+            consumed, total_secs, e1_secs = _drain(feed, split_at=total)
+            snap = feed.counters_snapshot()
+            feed.terminate()
+            if consumed != 2 * total:
+                raise RuntimeError(
+                    "affinity={} run consumed {} items, expected {}".format(
+                        affinity, consumed, 2 * total))
+            e2_secs = max(total_secs - (e1_secs or total_secs), 1e-9)
+            hits = snap.get("dataservice_affinity_hits", 0)
+            tally = snap.get("dataservice_affinity_total", 0)
+            return (round(total / e2_secs, 1),
+                    round(hits / tally, 4) if tally else None)
+        finally:
+            for w in workers:
+                w.stop()
+            disp.stop()
+
+    aff_ips, aff_rate = _epoch2_run(True)
+    noaff_ips, noaff_rate = _epoch2_run(False)
+    stats.update({
+        "affinity_epoch2_items_per_sec": aff_ips,
+        "noaffinity_epoch2_items_per_sec": noaff_ips,
+        "affinity_epoch2_gain": round(aff_ips / max(noaff_ips, 1e-9), 2),
+        "affinity_hit_rate": aff_rate,
+        "noaffinity_hit_rate": noaff_rate,
+    })
+    return stats
+
+
 def measure_serving_latency(points=(1, 8, 32), secs_per_point=1.2,
                             width=2048):
     """Serving-gateway latency/throughput: continuous batching vs the
@@ -896,6 +1055,7 @@ _LEGS = {
     "feedplane": measure_feedplane,
     "ceiling": measure_reference_feed_ceiling,
     "dataservice_cached_epoch": measure_dataservice_cached_epoch,
+    "shared_jobs": measure_shared_jobs,
     "serving_latency": measure_serving_latency,
     "warm_start": measure_warm_start,
 }
@@ -1182,6 +1342,7 @@ def main():
     feedplane, feedplane_err = run_leg_isolated("feedplane")
     ceiling, ceiling_err = run_leg_isolated("ceiling")
     dscache, dscache_err = run_leg_isolated("dataservice_cached_epoch")
+    shared, shared_err = run_leg_isolated("shared_jobs")
     servlat, servlat_err = run_leg_isolated("serving_latency")
     warmstart, warmstart_err = run_leg_isolated("warm_start")
     # The transformer leg runs LAST — after every graded leg,
@@ -1317,6 +1478,25 @@ def main():
         out["wire_compress_saved_bytes"] = dscache.get("wire_saved_bytes")
     elif dscache_err:
         out["dataservice_cached_epoch_error"] = dscache_err
+    if shared:
+        # multi-tenant tier: how much faster a second run attaches to a
+        # warm shared job than the cold solo run, and what the
+        # cache-affinity DYNAMIC scheduler buys over FCFS on a cached
+        # epoch (with the hit-rate tally under both settings as the
+        # explanation)
+        out["shared_attach_speedup"] = shared.get("shared_attach_speedup")
+        out["shared_cold_solo_secs"] = shared.get("shared_cold_solo_secs")
+        out["shared_warm_attach_secs"] = shared.get(
+            "shared_warm_attach_secs")
+        out["affinity_epoch2_items_per_sec"] = shared.get(
+            "affinity_epoch2_items_per_sec")
+        out["noaffinity_epoch2_items_per_sec"] = shared.get(
+            "noaffinity_epoch2_items_per_sec")
+        out["affinity_epoch2_gain"] = shared.get("affinity_epoch2_gain")
+        out["affinity_hit_rate"] = shared.get("affinity_hit_rate")
+        out["noaffinity_hit_rate"] = shared.get("noaffinity_hit_rate")
+    elif shared_err:
+        out["shared_jobs_error"] = shared_err
     if servlat:
         # serving gateway: best completed QPS under the load sweep with
         # continuous batching on vs the one-predict-per-request loop, the
@@ -1389,6 +1569,7 @@ def main():
         "feedplane": (feedplane or {}).get("value_source"),
         "ceiling": (ceiling or {}).get("value_source"),
         "dataservice_cached_epoch": (dscache or {}).get("value_source"),
+        "shared_jobs": (shared or {}).get("value_source"),
         "serving_latency": (servlat or {}).get("value_source"),
         "warm_start": (warmstart or {}).get("value_source"),
     }

@@ -55,7 +55,13 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # the warm-start compile plane: a SIGKILLed worker's replacement rejoins
 # with a deserialized (never retraced) step executable, compile debt a
 # small fraction of the cold nodes', exact element totals preserved, and
-# nonzero tfos_compile_cache_hit_total on a live /metrics scrape
+# nonzero tfos_compile_cache_hit_total on a live /metrics scrape, and
+# prove the multi-tenant tier survives chaos: two consumer runs attached
+# to ONE shared 2-epoch job, the journaled dispatcher subprocess
+# SIGKILLed and restarted mid-run on the same port, exact element totals
+# with zero duplicates across the crash, and nonzero
+# tfos_dataservice_cache_hit_total plus the affinity hit-rate on a live
+# /metrics scrape
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -67,5 +73,6 @@ python scripts/ci_assert_profiling.py
 python scripts/ci_assert_watchtower.py
 python scripts/ci_assert_serving.py
 python scripts/ci_assert_warmstart.py
+python scripts/ci_assert_shared.py
 
 exit $rc

@@ -299,6 +299,11 @@ HEADLINE_METRICS = (
     ("dataservice_epoch2_items_per_sec", "dataservice_cached_epoch",
      "higher"),
     ("wire_compress_ratio", "dataservice_cached_epoch", "higher"),
+    # multi-tenant data service (absent pre-round-13, skipped by run_diff)
+    ("shared_attach_speedup", "shared_jobs", "higher"),
+    ("affinity_epoch2_items_per_sec", "shared_jobs", "higher"),
+    ("affinity_epoch2_gain", "shared_jobs", "higher"),
+    ("affinity_hit_rate", "shared_jobs", "higher"),
     # serving gateway (absent pre-round-11, skipped by run_diff)
     ("serving_saturation_qps", "serving_latency", "higher"),
     ("serving_batch_speedup", "serving_latency", "higher"),

@@ -53,6 +53,31 @@ Wire protocol: the dispatcher speaks the length-prefixed-JSON
 ``HeartbeatSender`` verbatim).  Worker→consumer data streams use a 5-byte
 ``>IB`` prefix (payload length + kind): kind 0 JSON control, kind 1 a
 colv1 frame, kind 2 pickled rows.
+
+Multi-tenant v3 (tf.data-service shared jobs, arXiv:2210.14826 §4):
+
+- **Shared jobs** — ``JOB`` is attach-or-create: a second run naming the
+  same job with a compatible spec attaches as an additional *consumer*
+  of the live ledger and the splits are handed out across all attached
+  consumers exactly-once (each split streams to exactly ONE consumer; the
+  runs split the read).  A consumer that detaches (``DETACH``) or goes
+  silent past the heartbeat deadline has its bound splits rebound to the
+  surviving consumers; a fenced consumer's later reports are refused
+  under the same "fresh identity" rule as fenced workers.
+- **Cache-affinity DYNAMIC scheduling** — workers advertise the source
+  paths their :class:`_FrameCache` holds (registration + every
+  heartbeat); the ledger's DYNAMIC hand-out gives a requesting worker a
+  split it has cached, else one cached on no live worker (leaving warm
+  splits for their holders), else FCFS head-of-queue, so pull-balancing
+  is preserved and nothing ever waits on a cache holder.
+- **Journaled dispatcher** — with ``journal_dir`` set, every ledger
+  mutation is appended to a JSONL journal (flush-per-record) with
+  periodic full snapshots; a SIGKILLed dispatcher restarted on the same
+  port + journal dir replays the ledger and resumes in-flight jobs.
+  Workers re-register when a heartbeat answer carries ``reregister``
+  (the restarted dispatcher has never seen them); consumers reconnect
+  lazily.  In-flight assignments recover as consumer-bound pending
+  splits, so the consumer-side dedupe preserves exactly-once end to end.
 """
 
 import collections
@@ -143,7 +168,13 @@ class _Job(object):
     is streaming it) → ``completed`` (the consumer's ``DONE`` after a
     committed ``split_end``).  A worker death moves its assigned splits to
     ``pending[consumer]`` — still bound to the SAME consumer, so the
-    consumer-side dedupe set covers every path a duplicate could take."""
+    consumer-side dedupe set covers every path a duplicate could take.
+
+    Multi-tenant: ``consumers`` is the set of attached runs; a split is
+    handed out once regardless of how many consumers are attached (the
+    attached runs *split* the read).  :meth:`detach` rebinds a departing
+    consumer's splits to survivors (or back to the pool), and a fenced
+    consumer id can never re-attach (fresh-identity rule)."""
 
     def __init__(self, name, splits, num_epochs, mode):
         self.name = name
@@ -157,6 +188,10 @@ class _Job(object):
         self.reassigned = 0        # splits re-pooled from dead workers (total)
         self.static_owner = None   # split idx -> worker_id (STATIC, lazy)
         self.off_served = set()    # (worker, consumer) streams served (OFF)
+        self.consumers = set()     # attached consumer ids
+        self.fenced_consumers = set()
+        self.affinity_hits = 0     # DYNAMIC hand-outs landing on a holder
+        self.affinity_total = 0    # all DYNAMIC hand-outs (A/B denominator)
         self._init_epoch()
 
     def _init_epoch(self):
@@ -169,6 +204,53 @@ class _Job(object):
         return {"splits": self.splits, "num_epochs": self.num_epochs,
                 "mode": self.mode}
 
+    # -- consumers ---------------------------------------------------------
+
+    def attach(self, consumer_id):
+        """Attach a consumer; True when it is new to this job."""
+        if not consumer_id or consumer_id in self.consumers:
+            return False
+        self.consumers.add(consumer_id)
+        return True
+
+    def detach(self, consumer_id, fence=False):
+        """Detach a consumer and rebind its in-flight + pending splits to
+        the surviving consumers (round-robin) or back to the unassigned
+        pool when it was the last one.  ``fence=True`` additionally bans
+        the id (liveness fencing — a fenced-but-alive consumer's later
+        reports are refused, so its parked DONEs can never race the
+        rebound copies).  Returns how many splits moved."""
+        self.consumers.discard(consumer_id)
+        if fence:
+            self.fenced_consumers.add(consumer_id)
+        orphans = [s for s, (w, c) in self.assigned.items()
+                   if c == consumer_id]
+        for s in orphans:
+            del self.assigned[s]
+        orphans.extend(self.pending.pop(consumer_id, []))
+        heirs = sorted(self.consumers)
+        moved = 0
+        for i, s in enumerate(sorted(set(orphans))):
+            if s in self.completed:
+                continue
+            self._unbind(s)
+            if heirs:
+                self.pending.setdefault(heirs[i % len(heirs)], []).append(s)
+            else:
+                self.unassigned.append(s)
+            moved += 1
+        self.reassigned += moved
+        return moved
+
+    def _unbind(self, split):
+        """Remove a split from the unassigned pool and every pending list
+        (so a rebind never leaves a second copy behind)."""
+        if split in self.unassigned:
+            self.unassigned.remove(split)
+        for pend in self.pending.values():
+            if split in pend:
+                pend.remove(split)
+
     # -- assignment --------------------------------------------------------
 
     def _ensure_static_owners(self, live_workers):
@@ -178,10 +260,51 @@ class _Job(object):
                 i: owners[i % len(owners)] if owners else None
                 for i in range(len(self.splits))}
 
-    def next_splits(self, worker_id, consumer_id, live_workers):
+    def _pick(self, candidates, worker_id, worker_caches, affinity):
+        """The next DYNAMIC split for ``worker_id`` out of ``candidates``
+        (non-empty).  With affinity on, prefer (a) a split this worker's
+        cache holds, then (b) one no live worker holds — leaving warm
+        splits for their holders while they still have cold work — and
+        only then (c) the FCFS head.  (c) means a cold worker is never
+        starved waiting on a cache holder: availability wins at the tail,
+        which is the pull-scheduling analogue of least-loaded fallback."""
+        if not affinity or not worker_caches:
+            return candidates[0]
+        mine = worker_caches.get(worker_id) or ()
+        for s in candidates:
+            if self.splits[s] in mine:
+                return s
+        held = set()
+        for w, paths in worker_caches.items():
+            if w != worker_id:
+                held.update(paths)
+        if held:
+            for s in candidates:
+                if self.splits[s] not in held:
+                    return s
+        return candidates[0]
+
+    def _bind(self, split, worker_id, consumer_id, worker_caches):
+        self.assigned[split] = (worker_id, consumer_id)
+        if self.mode == SHARD_DYNAMIC:
+            # tallied for EVERY dynamic hand-out, affinity knob on or off,
+            # so the A/B bench can compare hit rates between the two
+            self.affinity_total += 1
+            if (worker_caches
+                    and self.splits[split] in
+                    (worker_caches.get(worker_id) or ())):
+                self.affinity_hits += 1
+        return {"splits": [[split, self.splits[split]]], "epoch": self.epoch}
+
+    def next_splits(self, worker_id, consumer_id, live_workers,
+                    worker_caches=None, affinity=False):
         """One TASK answer: ``{"splits": [[idx, path]], "epoch": e}``, or
         ``{"wait": True}`` (epoch still completing / nothing for this
-        worker yet), or ``{"done": True}`` (job exhausted)."""
+        worker yet), or ``{"done": True}`` (job exhausted).
+
+        ``worker_caches`` maps worker id → set of cached source paths (the
+        heartbeat advertisement); with ``affinity`` DYNAMIC hand-outs —
+        fresh and re-pooled alike — prefer cache holders (:meth:`_pick`)."""
         if self.mode == SHARD_OFF:
             key = (worker_id, consumer_id)
             if self.done or key in self.off_served:
@@ -191,15 +314,20 @@ class _Job(object):
                     "epoch": 0, "epochs": self.num_epochs}
         if self.done:
             return {"done": True}
+        dyn = self.mode == SHARD_DYNAMIC
         # 1. death-reassigned splits bound to this consumer (any worker may
         #    serve them — the original owner is gone)
         pend = self.pending.get(consumer_id)
-        while pend:
-            s = pend.pop(0)
-            if s in self.completed or s in self.assigned:
-                continue  # the zombie's copy already landed / re-pooled twice
-            self.assigned[s] = (worker_id, consumer_id)
-            return {"splits": [[s, self.splits[s]]], "epoch": self.epoch}
+        if pend:
+            # the zombie's copy already landed / re-pooled twice: drop those
+            valid = [s for s in pend
+                     if s not in self.completed and s not in self.assigned]
+            self.pending[consumer_id] = valid
+            if valid:
+                s = (self._pick(valid, worker_id, worker_caches, affinity)
+                     if dyn else valid[0])
+                valid.remove(s)
+                return self._bind(s, worker_id, consumer_id, worker_caches)
         # 2. fresh splits
         if self.mode == SHARD_STATIC:
             self._ensure_static_owners(live_workers)
@@ -207,13 +335,13 @@ class _Job(object):
                 owner = self.static_owner.get(s)
                 if owner is None or owner == worker_id:
                     self.unassigned.pop(i)
-                    self.assigned[s] = (worker_id, consumer_id)
-                    return {"splits": [[s, self.splits[s]]],
-                            "epoch": self.epoch}
+                    return self._bind(s, worker_id, consumer_id,
+                                      worker_caches)
         elif self.unassigned:
-            s = self.unassigned.pop(0)
-            self.assigned[s] = (worker_id, consumer_id)
-            return {"splits": [[s, self.splits[s]]], "epoch": self.epoch}
+            s = self._pick(self.unassigned, worker_id, worker_caches,
+                           affinity)
+            self.unassigned.remove(s)
+            return self._bind(s, worker_id, consumer_id, worker_caches)
         return {"wait": True}
 
     def complete(self, epoch, split, consumer_id):
@@ -238,13 +366,14 @@ class _Job(object):
 
     def release_worker(self, worker_id, live_workers):
         """Re-pool a dead (or departing) worker's uncompleted splits; STATIC
-        ownership of its unstarted splits transfers to survivors."""
-        moved = 0
+        ownership of its unstarted splits transfers to survivors.  Returns
+        the re-pooled ``(split, consumer)`` bindings (for the journal)."""
+        moved = []
         for s, (w, consumer) in list(self.assigned.items()):
             if w == worker_id:
                 del self.assigned[s]
                 self.pending.setdefault(consumer, []).append(s)
-                moved += 1
+                moved.append((s, consumer))
         if self.mode == SHARD_STATIC and self.static_owner:
             survivors = sorted(w for w in live_workers if w != worker_id)
             n = 0
@@ -253,7 +382,7 @@ class _Job(object):
                     self.static_owner[s] = (
                         survivors[n % len(survivors)] if survivors else None)
                     n += 1
-        self.reassigned += moved
+        self.reassigned += len(moved)
         return moved
 
     def release_split(self, epoch, split, worker_id, consumer_id):
@@ -299,12 +428,79 @@ class _Job(object):
                 "completed": len(self.completed),
                 "assigned": len(self.assigned),
                 "pending": sum(len(v) for v in self.pending.values()),
-                "reassigned": self.reassigned}
+                "reassigned": self.reassigned,
+                "consumers": len(self.consumers),
+                "affinity_hits": self.affinity_hits,
+                "affinity_total": self.affinity_total}
+
+    # -- journal state -----------------------------------------------------
+
+    def to_state(self):
+        """JSON-serializable full ledger state (snapshot records)."""
+        return {
+            "name": self.name, "splits": list(self.splits),
+            "num_epochs": self.num_epochs, "mode": self.mode,
+            "epoch": self.epoch, "done": self.done, "error": self.error,
+            "split_errors": sorted(self.split_errors.items()),
+            "reassigned": self.reassigned,
+            "static_owner": (sorted(self.static_owner.items())
+                             if self.static_owner is not None else None),
+            "off_served": sorted(list(k) for k in self.off_served),
+            "unassigned": list(self.unassigned),
+            "assigned": sorted([s, w, c]
+                               for s, (w, c) in self.assigned.items()),
+            "completed": sorted(self.completed),
+            "pending": {c: list(v) for c, v in self.pending.items()},
+            "consumers": sorted(self.consumers),
+            "fenced_consumers": sorted(self.fenced_consumers),
+        }
+
+    @classmethod
+    def from_state(cls, state):
+        job = cls(state["name"], state["splits"],
+                  state["num_epochs"], state["mode"])
+        job.epoch = int(state["epoch"])
+        job.done = bool(state["done"])
+        job.error = state.get("error")
+        job.split_errors = {int(k): int(v)
+                            for k, v in state.get("split_errors", [])}
+        job.reassigned = int(state.get("reassigned", 0))
+        so = state.get("static_owner")
+        job.static_owner = ({int(k): v for k, v in so}
+                            if so is not None else None)
+        job.off_served = set(tuple(k) for k in state.get("off_served", []))
+        job.unassigned = [int(s) for s in state.get("unassigned", [])]
+        job.assigned = {int(s): (w, c)
+                        for s, w, c in state.get("assigned", [])}
+        job.completed = set(int(s) for s in state.get("completed", []))
+        job.pending = {c: [int(s) for s in v]
+                       for c, v in (state.get("pending") or {}).items()}
+        job.consumers = set(state.get("consumers", []))
+        job.fenced_consumers = set(state.get("fenced_consumers", []))
+        return job
 
 
 # ---------------------------------------------------------------------------
 # DispatcherServer
 # ---------------------------------------------------------------------------
+
+def _env_int(name, default):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring non-integer %s=%r", name, raw)
+        return default
+
+
+def _env_flag(name, default):
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw not in ("0", "false", "off", "no")
+
 
 class DispatcherServer(MessageSocket):
     """Data-service control plane: worker registry + split ledgers.
@@ -318,24 +514,58 @@ class DispatcherServer(MessageSocket):
 
     Message types (length-prefixed JSON): ``WREG`` (worker registration),
     ``HBEAT``/``BYE`` (byte-compatible with the rendezvous, so workers
-    reuse ``HeartbeatSender``), ``JOB`` (idempotent job creation),
+    reuse ``HeartbeatSender``), ``JOB`` (attach-or-create job
+    registration), ``DETACH`` (consumer departure: rebind its splits),
     ``WORKERS`` (live roster for consumers), ``TASK`` (split request),
     ``DONE`` (consumer's split-visited report), ``LOST`` (consumer's
     broken-stream report: re-pool the mid-flight split without waiting
     for a fence), ``SPLIT_ERR`` (worker's reader-fault report: re-pool up
     to a budget, then fail the job with the cause), ``STATUS``, ``STOP``.
+
+    Durability: with ``journal_dir`` set (or ``TFOS_DS_JOURNAL_DIR``),
+    every ledger mutation appends one JSONL record to the current journal
+    segment, flushed per record; every ``snapshot_every`` records the
+    full state is snapshotted (``snapshot-<seq>.json``, atomic
+    tmp+rename) and a fresh segment (``journal-<seq>.jsonl``) starts.
+    :meth:`start` recovers from the newest snapshot plus its segment
+    before accepting connections — in-flight assignments come back as
+    consumer-bound pending splits (the assigned workers' streams died
+    with the old process), so the consumer-side dedupe keeps visitation
+    exactly-once across the restart.
+
+    ``affinity`` (default on; ``TFOS_DS_AFFINITY=0`` to disable) enables
+    cache-affinity DYNAMIC hand-out from the worker cache advertisements
+    riding WREG and HBEAT.  ``port`` pins the listen port (0 = ephemeral)
+    so a restarted dispatcher is reachable at the old address.
     """
 
     def __init__(self, heartbeat_interval=1.0, heartbeat_misses=3,
-                 host=None):
+                 host=None, port=0, journal_dir=None, snapshot_every=None,
+                 affinity=None):
         self.heartbeat_interval = heartbeat_interval
         self.heartbeat_misses = heartbeat_misses
         self._host = host
+        self._port = int(port)
+        if journal_dir is None:
+            journal_dir = os.environ.get("TFOS_DS_JOURNAL_DIR") or None
+        self.journal_dir = journal_dir
+        if snapshot_every is None:
+            snapshot_every = _env_int("TFOS_DS_SNAPSHOT_EVERY", 512)
+        self.snapshot_every = max(int(snapshot_every), 1)
+        if affinity is None:
+            affinity = _env_flag("TFOS_DS_AFFINITY", True)
+        self.affinity = bool(affinity)
         self._jobs = {}      # name -> _Job
         self._workers = {}   # worker_id -> {"worker_id","host","port"}
         self._beats = {}     # worker_id -> last beat (monotonic)
         self._dead = {}      # worker_id -> death description
         self._worker_metrics = {}  # worker_id -> latest HBEAT counters
+        self._worker_cache = {}    # worker_id -> cached source-path set
+        self._consumer_seen = {}   # (job, consumer) -> last contact
+        self._journal_file = None
+        self._journal_seq = 0
+        self._journal_count = 0
+        self.recovered_jobs = 0    # jobs rebuilt from the journal at start
         self._lock = threading.RLock()
         self._stopping = False
         self._socket = None
@@ -370,6 +600,184 @@ class DispatcherServer(MessageSocket):
             job = self._jobs.get(name)
             return job.status() if job is not None else None
 
+    # -- journal (caller holds the lock) -----------------------------------
+
+    def _segment_path(self, kind, seq):
+        ext = "jsonl" if kind == "journal" else "json"
+        return os.path.join(self.journal_dir,
+                            "{}-{:08d}.{}".format(kind, seq, ext))
+
+    def _journal(self, rec):
+        """Append one ledger-mutation record; flush-per-record so a SIGKILL
+        loses at most the record being written (a torn tail line, skipped
+        on replay).  A journal write failure degrades to in-memory-only
+        operation with a loud log — availability over durability."""
+        if self._journal_file is None:
+            return
+        try:
+            self._journal_file.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._journal_file.flush()
+        except (OSError, ValueError) as e:
+            logger.error("dataservice journal: write failed (%s); ledger "
+                         "durability is LOST until restart", e)
+            try:
+                self._journal_file.close()
+            except OSError:
+                pass
+            self._journal_file = None
+            return
+        self._journal_count += 1
+        if self._journal_count >= self.snapshot_every:
+            self._write_snapshot()
+
+    def _write_snapshot(self):
+        """Full-state snapshot (atomic tmp+rename) + fresh journal segment;
+        segments older than the previous generation are pruned."""
+        self._journal_seq += 1
+        seq = self._journal_seq
+        state = {"seq": seq,
+                 "jobs": {n: j.to_state() for n, j in self._jobs.items()},
+                 "dead_workers": dict(self._dead)}
+        path = self._segment_path("snapshot", seq)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            if self._journal_file is not None:
+                self._journal_file.close()
+            self._journal_file = open(self._segment_path("journal", seq), "a")
+        except OSError as e:
+            logger.error("dataservice journal: snapshot %d failed (%s)",
+                         seq, e)
+            self._journal_file = None
+        self._journal_count = 0
+        for old in range(seq - 2):
+            for kind in ("snapshot", "journal"):
+                try:
+                    os.unlink(self._segment_path(kind, old + 1))
+                except OSError:
+                    pass
+
+    def _replay(self, rec):
+        """Apply one journal record to the ledger (same mutation paths as
+        the live handlers, so replay and live execution cannot diverge)."""
+        t = rec.get("t")
+        if t == "job":
+            if rec["job"] not in self._jobs:
+                self._jobs[rec["job"]] = _Job(
+                    rec["job"], rec["splits"], rec["num_epochs"], rec["mode"])
+            return
+        if t == "fence":
+            self._dead[rec["worker"]] = rec.get(
+                "why", "fenced before a dispatcher restart")
+            return
+        job = self._jobs.get(rec.get("job"))
+        if job is None:
+            return
+        if t == "attach":
+            job.attach(rec["consumer"])
+        elif t == "detach":
+            job.detach(rec["consumer"], fence=bool(rec.get("fence")))
+        elif t in ("assign", "repool"):
+            s = int(rec["split"])
+            if (int(rec.get("epoch", 0)) == job.epoch
+                    and not job.done and s not in job.completed):
+                # the stream (if any) died with the old dispatcher's
+                # workers: recover the binding as consumer-bound pending
+                job.assigned.pop(s, None)
+                job._unbind(s)
+                job.pending.setdefault(rec["consumer"], []).append(s)
+        elif t == "done":
+            job.complete(int(rec.get("epoch", 0)), int(rec["split"]),
+                         rec.get("consumer"))
+        elif t == "split_err":
+            job.record_split_error(
+                int(rec.get("epoch", 0)), int(rec["split"]),
+                rec.get("worker"), rec.get("consumer"),
+                rec.get("error") or "reader failure")
+
+    def _recover(self):
+        """Rebuild the ledger from the newest snapshot + its journal
+        segment, then re-pool every recovered in-flight assignment (those
+        workers' streams are gone) and cut a fresh snapshot so the next
+        restart replays from here."""
+        os.makedirs(self.journal_dir, exist_ok=True)
+        seqs = []
+        for name in os.listdir(self.journal_dir):
+            if name.startswith("snapshot-") and name.endswith(".json"):
+                try:
+                    seqs.append(int(name[len("snapshot-"):-len(".json")]))
+                except ValueError:
+                    pass
+        seq = max(seqs) if seqs else 0
+        if seq:
+            try:
+                with open(self._segment_path("snapshot", seq)) as f:
+                    state = json.load(f)
+                self._jobs = {n: _Job.from_state(s)
+                              for n, s in state.get("jobs", {}).items()}
+                self._dead.update(state.get("dead_workers") or {})
+                self._journal_seq = int(state.get("seq", seq))
+            except (OSError, ValueError, KeyError) as e:
+                logger.error("dataservice journal: snapshot %d unreadable "
+                             "(%s); replaying the journal from scratch",
+                             seq, e)
+                self._jobs, self._journal_seq = {}, seq
+        replayed = 0
+        for jseq in sorted(s for s in self._list_segments() if s >= seq):
+            try:
+                with open(self._segment_path("journal", jseq)) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            break  # torn tail record from the SIGKILL
+                        self._replay(rec)
+                        replayed += 1
+            except OSError:
+                continue
+        for job in self._jobs.values():
+            for s, (w, c) in list(job.assigned.items()):
+                del job.assigned[s]
+                if s not in job.completed:
+                    job._unbind(s)
+                    job.pending.setdefault(c, []).append(s)
+        # arm consumer liveness for every recovered consumer: one that died
+        # while the dispatcher was down never makes contact again and must
+        # be fenced by silence like any other
+        now = time.monotonic()
+        for job in self._jobs.values():
+            if job.done or job.mode == SHARD_OFF:
+                continue
+            for c in job.consumers:
+                self._consumer_seen[(job.name, c)] = now
+        self.recovered_jobs = len(self._jobs)
+        if self._jobs or replayed or seq:
+            logger.warning(
+                "dataservice dispatcher: recovered %d job(s) from %s "
+                "(snapshot %d + %d journal record(s))",
+                len(self._jobs), self.journal_dir, seq, replayed)
+            telemetry.get_tracer().instant(
+                "dataservice/dispatcher_recover", jobs=len(self._jobs),
+                records=replayed)
+        self._write_snapshot()
+
+    def _list_segments(self):
+        out = []
+        for name in os.listdir(self.journal_dir):
+            if name.startswith("journal-") and name.endswith(".jsonl"):
+                try:
+                    out.append(int(name[len("journal-"):-len(".jsonl")]))
+                except ValueError:
+                    pass
+        return out
+
     # -- ledger mutation (listener thread, under lock) ---------------------
 
     def _register_worker(self, meta):
@@ -386,6 +794,9 @@ class DispatcherServer(MessageSocket):
                                     "host": meta["host"],
                                     "port": int(meta["port"])}
         self._beats[worker_id] = time.monotonic()
+        cached = meta.get("cache_splits")
+        if cached is not None:
+            self._worker_cache[worker_id] = set(cached)
         telemetry.get_tracer().instant(
             "dataservice/worker_register", worker_id=worker_id,
             workers=len(self._workers))
@@ -395,10 +806,16 @@ class DispatcherServer(MessageSocket):
         """Drop a worker from the roster and re-pool its splits."""
         self._workers.pop(worker_id, None)
         self._beats.pop(worker_id, None)
+        self._worker_cache.pop(worker_id, None)
         live = list(self._workers)
         moved = 0
         for job in self._jobs.values():
-            moved += job.release_worker(worker_id, live)
+            repooled = job.release_worker(worker_id, live)
+            for split, consumer in repooled:
+                self._journal({"t": "repool", "job": job.name,
+                               "epoch": job.epoch, "split": split,
+                               "consumer": consumer})
+            moved += len(repooled)
         if moved:
             logger.warning("dataservice: re-pooled %d split(s) from worker "
                            "%s (%s)", moved, worker_id, why)
@@ -421,10 +838,123 @@ class DispatcherServer(MessageSocket):
                                 self.heartbeat_interval)
                     logger.error("dataservice liveness: %s", desc)
                     self._dead[worker_id] = desc
+                    self._journal({"t": "fence", "worker": worker_id,
+                                   "why": desc})
                     telemetry.get_tracer().instant(
                         "dataservice/worker_dead", worker_id=worker_id,
                         age_secs=round(age, 3))
                     self._release_worker(worker_id, "dead")
+            # consumer liveness: any JOB/TASK/DONE/LOST/STATUS contact
+            # naming a consumer refreshes it; silence past the worker
+            # deadline fences the consumer and rebinds its splits to the
+            # survivors (or back to the pool) so a shared job never wedges
+            # on a crashed run
+            for key, last in list(self._consumer_seen.items()):
+                if now - last <= deadline:
+                    continue
+                del self._consumer_seen[key]
+                jobname, consumer = key
+                job = self._jobs.get(jobname)
+                if (job is None or job.done or job.error is not None
+                        or job.mode == SHARD_OFF
+                        or consumer not in job.consumers):
+                    continue
+                moved = job.detach(consumer, fence=True)
+                self._journal({"t": "detach", "job": jobname,
+                               "consumer": consumer, "fence": True})
+                logger.error(
+                    "dataservice liveness: consumer %s of job %r went "
+                    "silent; fenced, %d split(s) rebound", consumer,
+                    jobname, moved)
+                telemetry.get_tracer().instant(
+                    "dataservice/consumer_dead", job=jobname,
+                    consumer=consumer, splits=moved)
+
+    def _touch_consumer(self, job, consumer_id):
+        """Record consumer contact (liveness only applies to ledger modes;
+        OFF-mode jobs have no per-consumer bindings to rebind)."""
+        if job is not None and consumer_id and job.mode != SHARD_OFF:
+            self._consumer_seen[(job.name, consumer_id)] = time.monotonic()
+
+    def _handle_job(self, sock, data):
+        """Attach-or-create job registration.
+
+        ``attach`` in the request is ``"auto"`` (create the job if absent,
+        attach otherwise — the shared-job default), ``"create"`` (refuse an
+        existing job) or ``"attach"`` (refuse a missing one; ``splits`` may
+        be omitted and the reply's ``spec`` adopted).  An existing job with
+        an incompatible spec is always an error; so is attaching to a
+        finished/failed job or with a fenced consumer id."""
+        name = data.get("name")
+        consumer = data.get("consumer_id")
+        attach_mode = data.get("attach", "auto")
+        job = self._jobs.get(name)
+        spec = None
+        if data.get("splits") is not None:
+            spec = {"splits": list(data.get("splits") or []),
+                    "num_epochs": int(data.get("num_epochs", 1)),
+                    "mode": data.get("mode", SHARD_DYNAMIC)}
+            if spec["mode"] not in _MODES:
+                self.send(sock, {"type": "ERR",
+                                 "error": "unknown sharding mode {!r}"
+                                          .format(spec["mode"])})
+                return
+        if job is not None and consumer in job.fenced_consumers:
+            self.send(sock, {"type": "ERR",
+                             "error": "consumer {} of job {!r} was fenced "
+                                      "by the liveness monitor; a new run "
+                                      "must attach with a fresh identity"
+                                      .format(consumer, name)})
+            return
+        if job is None:
+            if attach_mode == "attach":
+                self.send(sock, {"type": "ERR",
+                                 "error": "job {!r} does not exist: nothing "
+                                          "to attach to".format(name)})
+                return
+            if spec is None:
+                self.send(sock, {"type": "ERR",
+                                 "error": "job {!r} needs splits to be "
+                                          "created".format(name)})
+                return
+            job = _Job(name, spec["splits"], spec["num_epochs"],
+                       spec["mode"])
+            self._jobs[name] = job
+            self._journal({"t": "job", "job": name, "splits": spec["splits"],
+                           "num_epochs": spec["num_epochs"],
+                           "mode": spec["mode"]})
+            telemetry.get_tracer().instant(
+                "dataservice/job", job=name, mode=spec["mode"],
+                splits=len(spec["splits"]), num_epochs=spec["num_epochs"])
+            created = True
+        else:
+            if attach_mode == "create":
+                self.send(sock, {"type": "ERR",
+                                 "error": "job {!r} already exists "
+                                          "(attach=False)".format(name)})
+                return
+            if spec is not None and job.spec() != spec:
+                self.send(sock, {"type": "ERR",
+                                 "error": "job {!r} already exists with a "
+                                          "different spec".format(name)})
+                return
+            if job.error is not None:
+                self.send(sock, {"type": "ERR",
+                                 "error": "job {!r} failed: {}".format(
+                                     name, job.error)})
+                return
+            created = False
+        if job.attach(consumer):
+            self._journal({"t": "attach", "job": name, "consumer": consumer})
+            telemetry.get_tracer().instant(
+                "dataservice/consumer_attach", job=name, consumer=consumer,
+                consumers=len(job.consumers))
+        self._touch_consumer(job, consumer)
+        reply = dict(job.spec())
+        self.send(sock, {"type": "OK", "created": created,
+                         "spec": reply, "epoch": job.epoch,
+                         "done": job.done,
+                         "consumers": len(job.consumers)})
 
     def _handle_message(self, sock, msg):
         mtype = msg.get("type")
@@ -446,42 +976,51 @@ class DispatcherServer(MessageSocket):
                 else:
                     # beats from ids we never saw register are tracked too
                     # (mirrors reservation.Server._beat)
+                    reply = {"type": "OK"}
                     if worker_id is not None:
                         self._beats[worker_id] = time.monotonic()
                         beat_metrics = data.get("metrics")
                         if isinstance(beat_metrics, dict):
+                            # the cache advertisement rides the metrics dict
+                            # but is a path list, not a counter: strip it
+                            # before the merge-by-sum vocabulary sees it
+                            paths = beat_metrics.pop("cache_paths", None)
+                            if paths is not None:
+                                self._worker_cache[worker_id] = set(paths)
                             self._worker_metrics.setdefault(
                                 worker_id, {}).update(beat_metrics)
-                    self.send(sock, {"type": "OK"})
+                        if worker_id not in self._workers:
+                            # a restarted dispatcher has never seen this
+                            # worker: tell it to re-register (WREG) so it
+                            # re-enters the roster with its data address
+                            reply["reregister"] = True
+                    self.send(sock, reply)
             elif mtype == "BYE":
                 worker_id = data.get("executor_id")
                 if worker_id is not None and worker_id in self._workers:
                     self._release_worker(worker_id, "bye")
                 self.send(sock, {"type": "OK"})
             elif mtype == "JOB":
-                name = data.get("name")
-                job = self._jobs.get(name)
-                spec = {"splits": list(data.get("splits") or []),
-                        "num_epochs": int(data.get("num_epochs", 1)),
-                        "mode": data.get("mode", SHARD_DYNAMIC)}
-                if spec["mode"] not in _MODES:
-                    self.send(sock, {"type": "ERR",
-                                     "error": "unknown sharding mode {!r}"
-                                              .format(spec["mode"])})
-                elif job is None:
-                    self._jobs[name] = _Job(name, spec["splits"],
-                                            spec["num_epochs"], spec["mode"])
-                    telemetry.get_tracer().instant(
-                        "dataservice/job", job=name, mode=spec["mode"],
-                        splits=len(spec["splits"]),
-                        num_epochs=spec["num_epochs"])
-                    self.send(sock, {"type": "OK", "created": True})
-                elif job.spec() == spec:
-                    self.send(sock, {"type": "OK", "created": False})
+                self._handle_job(sock, data)
+            elif mtype == "DETACH":
+                job = self._jobs.get(data.get("job"))
+                consumer = data.get("consumer_id")
+                if job is None or not consumer:
+                    self.send(sock, {"type": "OK", "stale": True})
+                elif consumer not in job.consumers:
+                    # duplicate departure (or a never-attached name): stale,
+                    # not an error — DETACH is the best-effort exit path
+                    self._consumer_seen.pop((job.name, consumer), None)
+                    self.send(sock, {"type": "OK", "stale": True})
                 else:
-                    self.send(sock, {"type": "ERR",
-                                     "error": "job {!r} already exists with "
-                                              "a different spec".format(name)})
+                    moved = job.detach(consumer)
+                    self._journal({"t": "detach", "job": job.name,
+                                   "consumer": consumer})
+                    telemetry.get_tracer().instant(
+                        "dataservice/consumer_detach", job=job.name,
+                        consumer=consumer, splits=moved)
+                    self._consumer_seen.pop((job.name, consumer), None)
+                    self.send(sock, {"type": "OK", "moved": moved})
             elif mtype == "WORKERS":
                 self.send(sock, {"type": "WORKERS",
                                  "data": sorted(self._workers.values(),
@@ -489,6 +1028,7 @@ class DispatcherServer(MessageSocket):
             elif mtype == "TASK":
                 job = self._jobs.get(data.get("job"))
                 worker_id = data.get("worker_id")
+                consumer_id = data.get("consumer_id")
                 if job is None:
                     self.send(sock, {"type": "ERR",
                                      "error": "unknown job {!r}"
@@ -500,14 +1040,29 @@ class DispatcherServer(MessageSocket):
                     self.send(sock, {"type": "ERR",
                                      "error": "marked dead by the liveness "
                                               "monitor"})
+                elif consumer_id in job.fenced_consumers:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "consumer {} of job {!r} was "
+                                              "fenced by the liveness "
+                                              "monitor".format(
+                                                  consumer_id, job.name)})
                 elif job.error is not None:
                     self.send(sock, {"type": "ERR",
                                      "error": "job {!r} failed: {}".format(
                                          job.name, job.error)})
                 else:
-                    ans = job.next_splits(worker_id, data.get("consumer_id"),
-                                          list(self._workers))
+                    self._touch_consumer(job, consumer_id)
+                    ans = job.next_splits(worker_id, consumer_id,
+                                          list(self._workers),
+                                          worker_caches=self._worker_cache,
+                                          affinity=self.affinity)
                     ans["type"] = "TASK"
+                    if ans.get("splits") and job.mode != SHARD_OFF:
+                        for s, _path in ans["splits"]:
+                            self._journal({"t": "assign", "job": job.name,
+                                           "epoch": job.epoch, "split": s,
+                                           "worker": worker_id,
+                                           "consumer": consumer_id})
                     if ans.get("splits"):
                         # Trace flow: a fresh id rides the assignment to the
                         # worker, the stream frames, and the consumer commit,
@@ -530,11 +1085,16 @@ class DispatcherServer(MessageSocket):
                                      "error": "unknown job {!r}"
                                               .format(data.get("job"))})
                 else:
+                    self._touch_consumer(job, data.get("consumer_id"))
                     ans = job.release_split(int(data.get("epoch", 0)),
                                             int(data.get("split", -1)),
                                             data.get("worker_id"),
                                             data.get("consumer_id"))
                     if not ans.get("stale"):
+                        self._journal({"t": "repool", "job": job.name,
+                                       "epoch": int(data.get("epoch", 0)),
+                                       "split": int(data.get("split", -1)),
+                                       "consumer": data.get("consumer_id")})
                         logger.warning(
                             "dataservice: split %s of job %r re-pooled "
                             "after a broken stream to worker %s",
@@ -558,6 +1118,14 @@ class DispatcherServer(MessageSocket):
                         int(data.get("split", -1)),
                         data.get("worker_id"), data.get("consumer_id"),
                         data.get("error") or "reader failure")
+                    if not ans.get("stale"):
+                        self._journal({
+                            "t": "split_err", "job": job.name,
+                            "epoch": int(data.get("epoch", 0)),
+                            "split": int(data.get("split", -1)),
+                            "worker": data.get("worker_id"),
+                            "consumer": data.get("consumer_id"),
+                            "error": data.get("error") or "reader failure"})
                     if ans.get("failed"):
                         logger.error("dataservice: job %r failed: %s",
                                      job.name, job.error)
@@ -572,10 +1140,26 @@ class DispatcherServer(MessageSocket):
                     self.send(sock, {"type": "ERR",
                                      "error": "unknown job {!r}"
                                               .format(data.get("job"))})
+                elif data.get("consumer_id") in job.fenced_consumers:
+                    # the fresh-identity rule for consumers: a fenced-but-
+                    # alive run's parked DONEs must not land after its
+                    # splits were rebound (the co-consumer republish race)
+                    self.send(sock, {"type": "ERR",
+                                     "error": "consumer {} of job {!r} was "
+                                              "fenced by the liveness "
+                                              "monitor".format(
+                                                  data.get("consumer_id"),
+                                                  job.name)})
                 else:
+                    self._touch_consumer(job, data.get("consumer_id"))
                     ans = job.complete(int(data.get("epoch", 0)),
                                        int(data.get("split", -1)),
                                        data.get("consumer_id"))
+                    if not (ans.get("stale") or ans.get("duplicate")):
+                        self._journal({"t": "done", "job": job.name,
+                                       "epoch": int(data.get("epoch", 0)),
+                                       "split": int(data.get("split", -1)),
+                                       "consumer": data.get("consumer_id")})
                     if job.done:
                         telemetry.get_tracer().instant(
                             "dataservice/job_done", job=job.name)
@@ -587,7 +1171,15 @@ class DispatcherServer(MessageSocket):
                     self.send(sock, {"type": "ERR",
                                      "error": "unknown job {!r}"
                                               .format(data.get("job"))})
+                elif data.get("consumer_id") in job.fenced_consumers:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "consumer {} of job {!r} was "
+                                              "fenced by the liveness "
+                                              "monitor".format(
+                                                  data.get("consumer_id"),
+                                                  job.name)})
                 else:
+                    self._touch_consumer(job, data.get("consumer_id"))
                     status = job.status()
                     status["workers"] = len(self._workers)
                     status["dead_workers"] = len(self._dead)
@@ -605,11 +1197,15 @@ class DispatcherServer(MessageSocket):
     # -- lifecycle ---------------------------------------------------------
 
     def start(self):
-        """Bind, spawn the daemon listener thread, return ``(host, port)``."""
+        """Bind, recover the ledger from the journal (when armed), spawn
+        the daemon listener thread, return ``(host, port)``."""
         self._socket = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._socket.bind(("", 0))
+        self._socket.bind(("", self._port))
         self._socket.listen(64)
+        if self.journal_dir:
+            with self._lock:
+                self._recover()
         host = self._host
         if not host:
             from tensorflowonspark_tpu import util
@@ -663,6 +1259,13 @@ class DispatcherServer(MessageSocket):
                 pass
         if self._thread is not None:
             self._thread.join(timeout=5)
+        with self._lock:
+            if self._journal_file is not None:
+                try:
+                    self._journal_file.close()
+                except OSError:
+                    pass
+                self._journal_file = None
 
 
 # ---------------------------------------------------------------------------
@@ -679,15 +1282,41 @@ class DispatcherClient(Client):
             raise DispatchError(resp.get("error", "dispatcher error"))
         return resp
 
-    def register_worker(self, worker_id, host, port):
-        self._call("WREG", {"worker_id": worker_id, "host": host,
-                            "port": int(port)})
+    def register_worker(self, worker_id, host, port, cache_splits=None):
+        data = {"worker_id": worker_id, "host": host, "port": int(port)}
+        if cache_splits is not None:
+            # the affinity advertisement: source paths this worker's chunk
+            # cache can replay (kept fresh by the heartbeat metrics)
+            data["cache_splits"] = list(cache_splits)
+        self._call("WREG", data)
 
-    def register_job(self, name, splits, num_epochs=1, mode=SHARD_DYNAMIC):
-        """Create (or idempotently re-assert) a dataset job."""
-        return self._call("JOB", {"name": name, "splits": list(splits),
-                                  "num_epochs": num_epochs,
-                                  "mode": mode}).get("created", False)
+    def register_job(self, name, splits=None, num_epochs=1,
+                     mode=SHARD_DYNAMIC, consumer_id=None, attach="auto"):
+        """Attach-or-create a dataset job.
+
+        ``attach="auto"`` (default) creates the job when absent and
+        attaches to it otherwise; ``attach=False`` refuses an existing
+        job; ``attach=True`` refuses a missing one — and then ``splits``
+        may be ``None``, adopting the live job's spec from the reply.
+        Returns the dispatcher's answer:
+        ``{"created", "spec", "epoch", "done", "consumers"}``.  An
+        existing job with an incompatible spec (different splits, epochs
+        or mode) raises :class:`DispatchError`."""
+        data = {"name": name, "num_epochs": num_epochs, "mode": mode,
+                "attach": {True: "attach", False: "create"}.get(
+                    attach, "auto")}
+        if splits is not None:
+            data["splits"] = list(splits)
+        if consumer_id:
+            data["consumer_id"] = consumer_id
+        resp = self._call("JOB", data)
+        return {k: resp.get(k)
+                for k in ("created", "spec", "epoch", "done", "consumers")}
+
+    def detach_job(self, name, consumer_id):
+        """Detach a consumer: its bound splits rebind to the survivors."""
+        return self._call("DETACH", {"job": name,
+                                     "consumer_id": consumer_id})
 
     def workers(self):
         """Live worker roster as a list of ``{worker_id, host, port}``."""
@@ -717,8 +1346,13 @@ class DispatcherClient(Client):
                                         "consumer_id": consumer_id,
                                         "error": error})
 
-    def status(self, job):
-        return self._call("STATUS", {"job": job}).get("data") or {}
+    def status(self, job, consumer_id=None):
+        data = {"job": job}
+        if consumer_id:
+            # names the caller so the dispatcher's consumer-liveness clock
+            # refreshes on every poll (and a fenced consumer learns loudly)
+            data["consumer_id"] = consumer_id
+        return self._call("STATUS", data).get("data") or {}
 
 
 def _default_retry_policy():
@@ -792,6 +1426,8 @@ class _FrameCache(object):
         self.invalidations = 0
         self.uncacheable = 0
         self.bytes_served = 0
+        self.spill_bytes = 0          # cumulative bytes written to spill
+        self._unreported_spill = 0    # since the last take_spill_bytes()
 
     @staticmethod
     def signature(path):
@@ -845,6 +1481,8 @@ class _FrameCache(object):
         entry["spill"] = path
         self._spilled[key] = entry
         self._spilled_bytes += entry["nbytes"]
+        self.spill_bytes += entry["nbytes"]
+        self._unreported_spill += entry["nbytes"]
         while self._spilled_bytes > self.spill_budget and self._spilled:
             old_key, old = self._spilled.popitem(last=False)
             self._drop(old_key, old)
@@ -945,6 +1583,22 @@ class _FrameCache(object):
         with self._lock:
             return self._resident
 
+    def take_spill_bytes(self):
+        """Spill bytes written since the last call (atomic take-and-reset;
+        the per-stream delta a worker rides on ``split_end`` — conserved
+        across concurrent serve streams)."""
+        with self._lock:
+            n, self._unreported_spill = self._unreported_spill, 0
+            return n
+
+    def cached_paths(self):
+        """Source paths with a resident or spilled entry — the affinity
+        advertisement this worker rides on WREG and every heartbeat."""
+        with self._lock:
+            paths = {k[0] for k in self._entries}
+            paths.update(k[0] for k in self._spilled)
+            return sorted(paths)
+
     def counters_flat(self):
         """The ``dataservice_cache_*`` heartbeat vocabulary (``_max``
         suffix = gauge, everything else cumulative counters)."""
@@ -955,6 +1609,7 @@ class _FrameCache(object):
                     "dataservice_cache_evictions": self.evictions,
                     "dataservice_cache_spills": self.spills,
                     "dataservice_cache_spill_hits": self.spill_hits,
+                    "dataservice_cache_spill_bytes": self.spill_bytes,
                     "dataservice_cache_invalidations": self.invalidations,
                     "dataservice_cache_resident_max": self._resident}
 
@@ -996,7 +1651,8 @@ class FeedWorker(object):
     def __init__(self, dispatcher_addr, row_reader=None, host="127.0.0.1",
                  port=0, worker_id=None, heartbeat_interval=1.0,
                  use_process_pool=False, num_procs=2, retry_policy=None,
-                 cache_bytes=None, cache_spill_dir=None):
+                 cache_bytes=None, cache_spill_dir=None,
+                 advertise_cache=None):
         self.dispatcher_addr = _addr_tuple(dispatcher_addr)
         self.row_reader = row_reader
         self.host = host
@@ -1016,6 +1672,13 @@ class FeedWorker(object):
         self.chunk_cache = (_FrameCache(cache_bytes,
                                         spill_dir=cache_spill_dir)
                             if cache_bytes else None)
+        if advertise_cache is None:
+            advertise_cache = _env_flag("TFOS_DS_ADVERTISE", True)
+        # the affinity advertisement only exists when there is a cache to
+        # advertise; --no-cache-advertise is the scheduler A/B knob
+        self.advertise_cache = bool(advertise_cache) and (
+            self.chunk_cache is not None)
+        self._last_rereg = 0.0
         # producer-side wire-compression accounting, incremented in place
         # by wire.frame_bytes (raw_bytes / wire_bytes / cols_* / frames)
         self.compress_stats = {}
@@ -1042,14 +1705,18 @@ class FeedWorker(object):
         def _register():
             client = DispatcherClient(self.dispatcher_addr)
             try:
-                client.register_worker(self.worker_id, self.host, self.port)
+                client.register_worker(
+                    self.worker_id, self.host, self.port,
+                    cache_splits=(self.chunk_cache.cached_paths()
+                                  if self.advertise_cache else None))
             finally:
                 client.close()
 
         self.retry_policy.call(_register)
         self._heartbeat = HeartbeatSender(
             self.dispatcher_addr, self.worker_id, self.heartbeat_interval,
-            metrics_provider=self._heartbeat_metrics).start()
+            metrics_provider=self._heartbeat_metrics,
+            on_reply=self._on_beat_reply).start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop,
             name="feedworker-accept-{}".format(self.worker_id), daemon=True)
@@ -1081,6 +1748,36 @@ class FeedWorker(object):
                 pass
         if self._heartbeat is not None:
             self._heartbeat.stop(goodbye=not abrupt)
+
+    def _on_beat_reply(self, resp):
+        """A heartbeat answer carrying ``reregister`` means the dispatcher
+        restarted and has never seen this worker: re-send WREG (throttled
+        to one attempt per heartbeat interval; best-effort — the next beat
+        retries).  Runs on the heartbeat thread."""
+        if not resp.get("reregister") or self._stop.is_set():
+            return
+        now = time.monotonic()
+        if now - self._last_rereg < self.heartbeat_interval:
+            return
+        self._last_rereg = now
+        try:
+            client = DispatcherClient(self.dispatcher_addr, retries=0)
+            try:
+                client.register_worker(
+                    self.worker_id, self.host, self.port,
+                    cache_splits=(self.chunk_cache.cached_paths()
+                                  if self.advertise_cache else None))
+            finally:
+                client.close()
+            logger.info("feed worker %s: re-registered with a restarted "
+                        "dispatcher", self.worker_id)
+        except DispatchError as e:
+            # e.g. a racing beat already re-registered us
+            logger.debug("feed worker %s: re-registration refused (%s)",
+                         self.worker_id, e)
+        except Exception as e:
+            logger.warning("feed worker %s: re-registration failed (%s)",
+                           self.worker_id, e)
 
     # -- stream serving ----------------------------------------------------
 
@@ -1243,6 +1940,9 @@ class FeedWorker(object):
                         end["cache_evicted"] = evicted
             if self.chunk_cache is not None:
                 end["cache_resident"] = self.chunk_cache.resident_bytes()
+                spilled = self.chunk_cache.take_spill_bytes()
+                if spilled:
+                    end["cache_spill_bytes"] = spilled
             _send_json(conn, end)
         self.splits_streamed += 1
         self._injector.on_split()
@@ -1299,6 +1999,10 @@ class FeedWorker(object):
         }
         if self.chunk_cache is not None:
             out.update(self.chunk_cache.counters_flat())
+            if self.advertise_cache:
+                # not a counter: the dispatcher strips this path list off
+                # before latching the numeric metrics
+                out["cache_paths"] = self.chunk_cache.cached_paths()
         stats = self.compress_stats
         if stats.get("frames"):
             out["wire_compress_raw_bytes"] = int(stats.get("raw_bytes", 0))
@@ -1354,11 +2058,23 @@ class ServiceFeed(object):
     tracks the dispatcher's worker roster, dialing workers as they appear
     (late joiners included) and detecting job completion.
 
+    Shared jobs: several runs naming the same ``job_name`` attach to ONE
+    ledger and split the read — each split streams to exactly one of the
+    attached consumers.  ``attach`` controls the registration stance:
+    ``"auto"`` (default) creates the job when absent and attaches
+    otherwise; ``True`` requires a live job (``files`` may then be
+    ``None`` — the live job's spec is adopted); ``False`` requires to be
+    first.  A consumer that terminates early detaches so its in-flight
+    splits rebind to the co-consumers; one that crashes silently is
+    fenced by the dispatcher after the heartbeat deadline.
+
     Args:
       dispatcher_addr: ``(host, port)`` or ``"host:port"``.
       files: split paths (the job's dataset; every consumer of a job must
-        pass the same list — job registration is idempotent).
+        pass the same list — job registration is attach-or-create).
+        ``None`` is allowed with ``attach=True`` only.
       job_name: dataset job identity shared by all its consumers.
+      attach: ``"auto"`` | ``True`` | ``False`` (see above).
       mode: :data:`SHARD_OFF` / :data:`SHARD_STATIC` / :data:`SHARD_DYNAMIC`.
       num_epochs: passes over the splits (epoch boundaries are invisible,
         like ``FileFeed``).
@@ -1384,12 +2100,20 @@ class ServiceFeed(object):
     def __init__(self, dispatcher_addr, files, job_name="default",
                  mode=SHARD_DYNAMIC, num_epochs=1, consumer_id=None,
                  input_mapping=None, prefetch=2, min_workers=1,
-                 retry_policy=None, timeout=60.0, codecs=None):
+                 retry_policy=None, timeout=60.0, codecs=None,
+                 attach="auto"):
         if mode not in _MODES:
             raise ValueError("unknown sharding mode {!r} (one of {})"
                              .format(mode, _MODES))
+        if attach not in ("auto", True, False):
+            raise ValueError('attach must be "auto", True or False, not {!r}'
+                             .format(attach))
+        if files is None and attach is not True:
+            raise ValueError("files=None needs attach=True (adopting the "
+                             "spec of a live job)")
         self.dispatcher_addr = _addr_tuple(dispatcher_addr)
-        self.files = list(files)
+        self.files = list(files) if files is not None else None
+        self.attach = attach
         self.job_name = job_name
         self.mode = mode
         self.num_epochs = num_epochs
@@ -1415,9 +2139,12 @@ class ServiceFeed(object):
         self.cache_misses = 0
         self.cache_evictions = 0
         self.cache_bytes = 0
+        self.cache_spill_bytes = 0
         self.compress_raw_bytes = 0
         self.compress_wire_bytes = 0
         self._cache_resident = {}   # worker_id -> latest resident gauge
+        self._affinity = {}         # latest job-level affinity counters
+        self.created_job = None     # True created / False attached (started)
         self._fault = fault.from_env()
         self._chunks = _queue.Queue(maxsize=max(2, prefetch))
         self._buffer = []
@@ -1451,8 +2178,21 @@ class ServiceFeed(object):
         self._started = True
         client = self.retry_policy.call(
             lambda: DispatcherClient(self.dispatcher_addr))
-        client.register_job(self.job_name, self.files,
-                            num_epochs=self.num_epochs, mode=self.mode)
+        reply = client.register_job(self.job_name, self.files,
+                                    num_epochs=self.num_epochs,
+                                    mode=self.mode,
+                                    consumer_id=self.consumer_id,
+                                    attach=self.attach)
+        self.created_job = bool(reply.get("created"))
+        if self.files is None:
+            # attach=True without files: adopt the live job's spec (the
+            # receive plane needs the mode before any stream dials)
+            spec = reply.get("spec") or {}
+            self.files = list(spec.get("splits") or [])
+            mode = spec.get("mode", self.mode)
+            if mode in _MODES:
+                self.mode = mode
+            self.num_epochs = spec.get("num_epochs", self.num_epochs)
         self._maintainer = threading.Thread(
             target=self._maintain, args=(client,),
             name="servicefeed-maintain-{}".format(self.consumer_id),
@@ -1460,16 +2200,47 @@ class ServiceFeed(object):
         self._maintainer.start()
 
     def _maintain(self, client):
-        """Roster tracking + completion detection (daemon thread)."""
+        """Roster tracking + completion detection (daemon thread).
+
+        The dispatcher connection is treated as replaceable: any transport
+        error drops it and the next tick redials (``retries=0`` per
+        attempt — the loop itself is the retry), so a dispatcher restarted
+        from its journal is picked up within a tick or two.  Dispatcher
+        downtime is NOT progress — the watchdog keeps running, bounding
+        how long a dead control plane can stall the feed."""
         off_bound = None  # OFF mode: the worker set frozen at binding time
         last_sig = None   # last observed ledger-progress signature
+        job_done = False  # normal completion (no DETACH needed)
         try:
             while not self._stop.is_set():
+                if client is None:
+                    try:
+                        client = DispatcherClient(self.dispatcher_addr,
+                                                  retries=0)
+                    except (OSError, EOFError, TimeoutError,
+                            ConnectionError) as e:
+                        logger.warning("servicefeed: dispatcher unreachable "
+                                       "(%s); redialing", e)
+                        if (time.monotonic()
+                                - self._last_progress) > self.timeout:
+                            raise TimeoutError(
+                                "data service made no progress for {}s "
+                                "(job {!r}, dispatcher unreachable)".format(
+                                    self.timeout, self.job_name))
+                        time.sleep(0.2)
+                        continue
                 try:
                     roster = {m["worker_id"]: m for m in client.workers()}
-                except (DispatchError, OSError, EOFError, TimeoutError) as e:
+                except DispatchError as e:
+                    logger.warning("servicefeed: worker listing refused "
+                                   "(%s)", e)
+                    roster = {}
+                except (OSError, EOFError, TimeoutError,
+                        ConnectionError) as e:
                     logger.warning("servicefeed: worker listing failed (%s)",
                                    e)
+                    client.close()
+                    client = None
                     roster = {}
                 if self.mode == SHARD_OFF:
                     if off_bound is None:
@@ -1490,7 +2261,8 @@ class ServiceFeed(object):
                                 daemon=True)
                             self._streams[worker_id] = t
                             t.start()
-                self._flush_pending_done(client)
+                if client is not None:
+                    self._flush_pending_done(client)
                 # completion: ledger modes ask the dispatcher; OFF is purely
                 # per-stream (all bound streams finished)
                 if self.mode == SHARD_OFF:
@@ -1498,19 +2270,35 @@ class ServiceFeed(object):
                         threads = list(self._streams.values())
                     if (off_bound is not None and threads
                             and all(not t.is_alive() for t in threads)):
+                        job_done = True
                         break
-                else:
+                elif client is not None:
                     status = None
                     try:
-                        status = client.status(self.job_name)
-                    except (DispatchError, OSError, EOFError, TimeoutError):
-                        pass
+                        status = client.status(self.job_name,
+                                               consumer_id=self.consumer_id)
+                    except DispatchError as e:
+                        if "fenced" in str(e):
+                            # our identity is burnt (we went silent past
+                            # the deadline and our splits were rebound):
+                            # continuing would double-deliver via parked
+                            # DONEs, so fail loudly instead
+                            raise
+                    except (OSError, EOFError, TimeoutError,
+                            ConnectionError):
+                        client.close()
+                        client = None
                     if status is not None:
                         if status.get("error"):
                             raise DispatchError(
                                 "data service job {!r} failed: {}".format(
                                     self.job_name, status["error"]))
+                        if status.get("affinity_total"):
+                            self._affinity = {
+                                "hits": int(status.get("affinity_hits", 0)),
+                                "total": int(status["affinity_total"])}
                         if status.get("done"):
+                            job_done = True
                             break
                         # any ledger movement is progress: a co-consumer's
                         # commits keep this (possibly idle) consumer's
@@ -1540,7 +2328,28 @@ class ServiceFeed(object):
             # a slow-draining consumer keeps its tail
             self._publish(_SENTINEL)
         finally:
-            client.close()
+            if not job_done and self.mode != SHARD_OFF:
+                # early exit (terminate / error): detach so our in-flight
+                # splits rebind to co-consumers NOW instead of after the
+                # liveness deadline; best-effort — the fence is the backstop
+                self._detach_quietly(client)
+                client = None
+            if client is not None:
+                client.close()
+
+    def _detach_quietly(self, client):
+        """Best-effort DETACH on the early-exit path (reuses the
+        maintainer's client when it is still healthy)."""
+        try:
+            if client is None:
+                client = DispatcherClient(self.dispatcher_addr, retries=0)
+            try:
+                client.detach_job(self.job_name, self.consumer_id)
+            finally:
+                client.close()
+        except Exception as e:
+            logger.info("servicefeed: detach of %s from job %r not "
+                        "delivered (%s)", self.consumer_id, self.job_name, e)
 
     def _finish_streams(self):
         """Post-completion receiver wind-down — without dropping data.
@@ -1645,6 +2454,11 @@ class ServiceFeed(object):
                 # with the first codec it supports (raw frames otherwise)
                 hello["codecs"] = list(self.codecs)
             _send_json(sock, hello)
+            with self._stream_lock:
+                # a successful dial+hello proves the worker is healthy:
+                # reset its failure budget so a long job survives more
+                # than 3 transient stream resets to the same worker
+                self._dial_failures.pop(worker_id, None)
             self._last_progress = time.monotonic()
             while not self._stop.is_set():
                 kind, payload = _recv_frame(sock)
@@ -1733,6 +2547,7 @@ class ServiceFeed(object):
             self.cache_misses += 1
         self.cache_bytes += int(msg.get("cache_bytes", 0) or 0)
         self.cache_evictions += int(msg.get("cache_evicted", 0) or 0)
+        self.cache_spill_bytes += int(msg.get("cache_spill_bytes", 0) or 0)
         if "cache_resident" in msg:
             self._cache_resident[worker_id] = int(msg["cache_resident"])
 
@@ -2023,9 +2838,18 @@ class ServiceFeed(object):
         snap["dataservice_cache_miss"] = self.cache_misses
         snap["dataservice_cache_bytes"] = self.cache_bytes
         snap["dataservice_cache_evictions"] = self.cache_evictions
+        snap["dataservice_cache_spill_bytes"] = self.cache_spill_bytes
         if self._cache_resident:
             snap["dataservice_cache_resident_max"] = max(
                 self._cache_resident.values())
+        # job-level affinity counters (polled off STATUS by the maintainer):
+        # hits / total DYNAMIC hand-outs — the scheduler's A/B metric
+        aff = self._affinity
+        if aff.get("total"):
+            snap["dataservice_affinity_hits"] = aff.get("hits", 0)
+            snap["dataservice_affinity_total"] = aff["total"]
+            snap["dataservice_affinity_hit_pct_max"] = round(
+                100.0 * aff.get("hits", 0) / aff["total"], 2)
         if self.compress_wire_bytes:
             from . import metrics as _metrics
             snap["wire_compress_saved_bytes"] = (

@@ -1,0 +1,86 @@
+"""CLI entry for a standalone data-service dispatcher process.
+
+Runs one :class:`~tensorflowonspark_tpu.dataservice.DispatcherServer`
+until SIGTERM / Ctrl-C.  With ``--journal-dir`` the split ledger is
+journaled (JSONL mutations + periodic snapshots) and a restarted
+dispatcher — same ``--port``, same ``--journal-dir`` — recovers every
+job's ledger before accepting connections, so SIGKILLing this process is
+survivable: workers re-register off the heartbeat ``reregister`` hint,
+consumers reconnect lazily, and in-flight splits resume exactly-once.
+
+Usage::
+
+    python -m tensorflowonspark_tpu.dataservice_dispatcher \\
+        [--host H] [--port P] [--heartbeat SECS] [--misses N] \\
+        [--journal-dir DIR] [--snapshot-every N] \\
+        [--affinity | --no-affinity]
+
+Env fallbacks (flags win): ``TFOS_DS_JOURNAL_DIR``,
+``TFOS_DS_SNAPSHOT_EVERY``, ``TFOS_DS_AFFINITY`` — the same shape as the
+worker CLI's ``TFOS_DS_CACHE_BYTES``.
+"""
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="tensorflowonspark_tpu data-service dispatcher")
+    parser.add_argument("--host", default=None,
+                        help="advertise host (default: auto-detected)")
+    parser.add_argument("--port", type=int, default=0,
+                        help="listen port (default: ephemeral; pin it so a "
+                             "restarted dispatcher keeps its address)")
+    parser.add_argument("--heartbeat", type=float, default=1.0,
+                        help="worker heartbeat interval seconds")
+    parser.add_argument("--misses", type=int, default=3,
+                        help="missed heartbeats before fencing")
+    parser.add_argument("--journal-dir", default=None,
+                        help="journal ledger mutations under this dir "
+                             "(default: TFOS_DS_JOURNAL_DIR env; unset "
+                             "disables durability)")
+    parser.add_argument("--snapshot-every", type=int, default=None,
+                        help="journal records between full snapshots "
+                             "(default: TFOS_DS_SNAPSHOT_EVERY env, 512)")
+    parser.add_argument("--affinity", dest="affinity", action="store_true",
+                        default=None,
+                        help="cache-affinity DYNAMIC scheduling (default: "
+                             "TFOS_DS_AFFINITY env, on)")
+    parser.add_argument("--no-affinity", dest="affinity",
+                        action="store_false",
+                        help="plain FCFS DYNAMIC scheduling")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    from tensorflowonspark_tpu import dataservice, telemetry
+
+    tracer = telemetry.configure_from_meta({})
+    telemetry.install_sigusr1()
+
+    dispatcher = dataservice.DispatcherServer(
+        heartbeat_interval=args.heartbeat, heartbeat_misses=args.misses,
+        host=args.host, port=args.port, journal_dir=args.journal_dir,
+        snapshot_every=args.snapshot_every, affinity=args.affinity)
+    host, port = dispatcher.start()
+    print("dispatcher ready on {}:{}".format(host, port), flush=True)
+
+    done = threading.Event()
+    signal.signal(signal.SIGTERM, lambda signum, frame: done.set())
+    try:
+        done.wait()
+    except KeyboardInterrupt:
+        pass
+    dispatcher.stop()
+    tracer.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,7 +10,11 @@ Usage::
     python -m tensorflowonspark_tpu.dataservice_worker \\
         --dispatcher HOST:PORT [--reader jsonl|tfrecord] [--host H] \\
         [--port P] [--worker-id ID] [--heartbeat SECS] [--process-pool] \\
-        [--cache-bytes N] [--cache-spill-dir DIR]
+        [--cache-bytes N] [--cache-spill-dir DIR] [--no-cache-advertise]
+
+The standalone dispatcher lives in
+:mod:`~tensorflowonspark_tpu.dataservice_dispatcher` (journal + affinity
+knobs are dispatcher-side).
 """
 
 import argparse
@@ -43,6 +47,12 @@ def main(argv=None):
                              "TFOS_DS_CACHE_BYTES env, 0/unset disables)")
     parser.add_argument("--cache-spill-dir", default=None,
                         help="spill LRU-evicted cache entries to this dir")
+    parser.add_argument("--no-cache-advertise", dest="advertise_cache",
+                        action="store_false", default=None,
+                        help="do not advertise cached splits to the "
+                             "dispatcher (disables cache-affinity "
+                             "scheduling for this worker; default: "
+                             "TFOS_DS_ADVERTISE env, on)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -67,7 +77,8 @@ def main(argv=None):
         heartbeat_interval=args.heartbeat,
         use_process_pool=args.process_pool,
         cache_bytes=args.cache_bytes,
-        cache_spill_dir=args.cache_spill_dir)
+        cache_spill_dir=args.cache_spill_dir,
+        advertise_cache=args.advertise_cache)
     worker.start()
     print("worker {} ready on {}:{}".format(worker.worker_id, worker.host,
                                             worker.port), flush=True)

@@ -882,7 +882,8 @@ class HeartbeatSender(object):
     """
 
     def __init__(self, server_addr, executor_id, interval,
-                 metrics_provider=None, trace_flow=None, on_profile=None):
+                 metrics_provider=None, trace_flow=None, on_profile=None,
+                 on_reply=None):
         """``metrics_provider``: optional zero-arg callable returning a flat
         JSON-serializable counter dict to piggyback on each beat (errors are
         swallowed — metrics must never cost a liveness beat).
@@ -895,13 +896,20 @@ class HeartbeatSender(object):
         daemon thread — a capture takes seconds, and blocking the beat loop
         that long would fence the node — and its result is uploaded via
         :meth:`Client.profile_result` on a dedicated connection (the beat
-        client is not thread-safe).  Requests are deduped by capture id."""
+        client is not thread-safe).  Requests are deduped by capture id.
+        ``on_reply``: optional ``fn(reply_dict)`` called with every
+        accepted beat's reply on the beat thread (servers piggyback
+        hints there, e.g. the data-service dispatcher's ``reregister``
+        after a restart).  Exceptions are swallowed — a reply hook must
+        never cost a liveness beat; keep it fast or hand off to a
+        thread."""
         self.server_addr = tuple(server_addr)
         self.executor_id = executor_id
         self.interval = interval
         self.metrics_provider = metrics_provider
         self.trace_flow = trace_flow
         self.on_profile = on_profile
+        self.on_reply = on_reply
         self.fenced = False
         self._stop = threading.Event()
         self._client = None
@@ -952,8 +960,15 @@ class HeartbeatSender(object):
                         "stopping heartbeats", self.executor_id)
                     self.fenced = True
                     return
-                if isinstance(resp, dict) and resp.get("profile"):
-                    self._maybe_capture(resp["profile"])
+                if isinstance(resp, dict):
+                    if resp.get("profile"):
+                        self._maybe_capture(resp["profile"])
+                    if self.on_reply is not None:
+                        try:
+                            self.on_reply(resp)
+                        except Exception as e:
+                            logger.debug("heartbeat on_reply hook failed: "
+                                         "%s", e)
             except Exception as e:
                 logger.warning("heartbeat failed (%s); will retry with a "
                                "fresh connection", e)

@@ -492,19 +492,26 @@ class RuleEngine(object):
                 continue
             evictions = d["deltas"].get("dataservice_cache_evictions", 0)
             hits = d["deltas"].get("dataservice_cache_hit", 0)
+            spill_bytes = d["deltas"].get("dataservice_cache_spill_bytes", 0)
             if evictions < cfg["cache_thrash_min_evictions"]:
                 continue
             ratio = evictions / max(float(hits), 1.0)
             if ratio >= cfg["cache_thrash_evict_hit_ratio"]:
+                # the spill delta separates "entries silently dropped"
+                # (no spill dir: capacity loss) from "disk churning under
+                # the eviction storm" (spill armed: I/O cost)
                 alerts.append(self._alert(
                     "cache_thrash", now, executor=node, severity="warn",
                     value=round(ratio, 3),
                     threshold=cfg["cache_thrash_evict_hit_ratio"],
                     evictions=evictions, hits=hits,
+                    spill_bytes=spill_bytes,
                     message="executor {} chunk cache thrashing: {} "
-                            "evictions vs {} hits in {:.0f}s — raise "
+                            "evictions vs {} hits in {:.0f}s{} — raise "
                             "cache_bytes / TFOS_DS_CACHE_BYTES".format(
-                                node, evictions, hits, d["span_secs"])))
+                                node, evictions, hits, d["span_secs"],
+                                (" ({} B spilled)".format(spill_bytes)
+                                 if spill_bytes else ""))))
         return alerts
 
     def _rule_latency_slo_burn(self, window, now):

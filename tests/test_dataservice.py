@@ -40,15 +40,18 @@ def _write_jsonl(dirpath, n_splits, per_split, row_fn=None):
 class _Service(object):
     """In-process dispatcher + N feed workers with fast heartbeats."""
 
-    def __init__(self, n_workers=2, heartbeat=0.2, misses=2):
+    def __init__(self, n_workers=2, heartbeat=0.2, misses=2,
+                 cache_bytes=None, **dispatcher_kwargs):
         self.dispatcher = DispatcherServer(heartbeat_interval=heartbeat,
                                            heartbeat_misses=misses,
-                                           host="127.0.0.1")
+                                           host="127.0.0.1",
+                                           **dispatcher_kwargs)
         self.addr = self.dispatcher.start()
         self.workers = [
             FeedWorker(self.addr, row_reader=data.jsonl_rows,
                        worker_id="w{}".format(i),
-                       heartbeat_interval=heartbeat).start()
+                       heartbeat_interval=heartbeat,
+                       cache_bytes=cache_bytes).start()
             for i in range(n_workers)]
 
     def __enter__(self):
@@ -130,17 +133,36 @@ def test_fenced_worker_is_rejected_and_splits_reassigned():
         disp.stop()
 
 
-def test_job_registration_is_idempotent_but_spec_changes_error():
+def test_job_registration_is_attach_or_create():
     disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1")
     addr = disp.start()
     try:
         client = DispatcherClient(addr)
-        assert client.register_job("j", ["a", "b"], num_epochs=2) is True
-        assert client.register_job("j", ["a", "b"], num_epochs=2) is False
+        first = client.register_job("j", ["a", "b"], num_epochs=2,
+                                    consumer_id="c0")
+        assert first["created"] is True
+        assert first["consumers"] == 1
+        # same spec, second run: attaches instead of erroring
+        second = client.register_job("j", ["a", "b"], num_epochs=2,
+                                     consumer_id="c1")
+        assert second["created"] is False
+        assert second["consumers"] == 2
+        assert second["spec"]["splits"] == ["a", "b"]
+        # incompatible re-attach is a typed error
         with pytest.raises(DispatchError, match="different spec"):
             client.register_job("j", ["a", "b"], num_epochs=3)
         with pytest.raises(DispatchError, match="sharding mode"):
             client.register_job("k", ["a"], mode="bogus")
+        # attach=True demands a live job; attach=False demands to be first
+        with pytest.raises(DispatchError, match="nothing to attach"):
+            client.register_job("nope", ["a"], attach=True)
+        with pytest.raises(DispatchError, match="already exists"):
+            client.register_job("j", ["a", "b"], num_epochs=2, attach=False)
+        # attach=True without splits adopts the live job's spec
+        adopted = client.register_job("j", consumer_id="c2", attach=True)
+        assert adopted["spec"] == {"splits": ["a", "b"], "num_epochs": 2,
+                                   "mode": "dynamic"}
+        assert adopted["consumers"] == 3
         client.close()
     finally:
         disp.stop()
@@ -902,3 +924,383 @@ def test_wire_codec_env_knob_and_explicit_list(tmp_path, monkeypatch):
     with pytest.raises(ValueError, match="unsupported wire codec"):
         ServiceFeed(("127.0.0.1", 1), splits, job_name="bad",
                     codecs=["snappy"])
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant v3: shared jobs, cache-affinity scheduling, journaled ledger
+# ---------------------------------------------------------------------------
+
+def test_concurrent_register_job_race_single_creator():
+    """N consumers race register_job for the same name: the dispatcher lock
+    serializes them into exactly one create and N-1 attaches — never a
+    duplicate ledger, never an error."""
+    disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1")
+    addr = disp.start()
+    try:
+        results, errors = [], []
+        barrier = threading.Barrier(4)
+
+        def attempt(i):
+            client = DispatcherClient(addr)
+            try:
+                barrier.wait(timeout=10)
+                results.append(client.register_job(
+                    "race", ["s0", "s1"], consumer_id="c{}".format(i)))
+            except Exception as e:  # surfaced below, not swallowed
+                errors.append(e)
+            finally:
+                client.close()
+
+        threads = [threading.Thread(target=attempt, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert not errors
+        assert sum(1 for r in results if r["created"]) == 1
+        assert all(r["spec"]["splits"] == ["s0", "s1"] for r in results)
+        client = DispatcherClient(addr)
+        assert client.status("race")["consumers"] == 4
+        client.close()
+    finally:
+        disp.stop()
+
+
+def test_detach_rebinds_inflight_splits_to_survivor():
+    """A clean DETACH re-binds the leaver's splits to a surviving
+    co-consumer (not back to the free pool: the heir keeps the warm
+    stream); a duplicate DETACH is stale, not an error."""
+    disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1")
+    addr = disp.start()
+    try:
+        client = DispatcherClient(addr)
+        client.register_worker("w", "127.0.0.1", 1)
+        client.register_job("j", ["s0", "s1", "s2"], consumer_id="c0")
+        reply = client.register_job("j", consumer_id="c1", attach=True)
+        assert not reply["created"] and reply["consumers"] == 2
+        assert client.request_task("j", "w", "c0")["splits"] == [[0, "s0"]]
+        assert client.detach_job("j", "c0")["moved"] == 1
+        status = client.status("j")
+        assert status["consumers"] == 1 and status["pending"] == 1
+        assert client.request_task("j", "w", "c1")["splits"] == [[0, "s0"]]
+        assert client.detach_job("j", "c0").get("stale")
+        client.close()
+    finally:
+        disp.stop()
+
+
+def test_silent_consumer_is_fenced_and_rejected():
+    """Consumer liveness: a consumer that goes silent past the heartbeat
+    deadline is fenced — its splits re-bind to the survivor, its identity
+    is dead (DONE and re-attach answer a typed 'fenced' error), and a
+    fresh identity attaches fine."""
+    disp = DispatcherServer(heartbeat_interval=0.1, heartbeat_misses=2,
+                            host="127.0.0.1")
+    addr = disp.start()
+    try:
+        client = DispatcherClient(addr)
+        client.register_worker("w", "127.0.0.1", 1)
+        client.register_job("j", ["s0", "s1"], consumer_id="c0")
+        client.register_job("j", consumer_id="c1", attach=True)
+        assert client.request_task("j", "w", "c0")["splits"] == [[0, "s0"]]
+        deadline = time.monotonic() + 5
+        while client.status("j", consumer_id="c1")["consumers"] > 1:
+            assert time.monotonic() < deadline, "consumer never fenced"
+            time.sleep(0.03)
+        with pytest.raises(DispatchError, match="fenced"):
+            client.done_split("j", 0, 0, "c0")
+        with pytest.raises(DispatchError, match="fenced"):
+            client.register_job("j", consumer_id="c0", attach=True)
+        # fresh-identity rule: a new name attaches fine
+        assert client.register_job("j", consumer_id="c0b",
+                                   attach=True)["consumers"] == 2
+        # the orphan re-bound to the survivor ("w" got fenced for the same
+        # silence, so a fresh worker drains it)
+        client.register_worker("w2", "127.0.0.1", 2)
+        assert client.request_task("j", "w2", "c1")["splits"] == [[0, "s0"]]
+        client.close()
+    finally:
+        disp.stop()
+
+
+def test_shared_job_two_consumers_split_the_read(tmp_path):
+    """The tentpole e2e: a second run attaches to the first run's job
+    (files=None adopts the registered spec) and the two consumers split
+    the read — the union of what they see is the dataset exactly once."""
+    splits, rows = _write_jsonl(tmp_path, 8, 25)
+    with _Service(n_workers=2) as svc:
+        feed_a = ServiceFeed(svc.addr, splits, job_name="shared",
+                             mode=SHARD_DYNAMIC, timeout=30.0)
+        feed_a._ensure_started()  # deterministic create-before-attach
+        assert feed_a.created_job is True
+        feed_b = ServiceFeed(svc.addr, None, job_name="shared",
+                             attach=True, timeout=30.0)
+        got = {}
+
+        def run(feed, key):
+            got[key] = _drain(feed)
+
+        threads = [threading.Thread(target=run, args=(f, k), daemon=True)
+                   for f, k in ((feed_a, "a"), (feed_b, "b"))]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=40)
+            assert sorted(got["a"] + got["b"]) == sorted(rows)
+            assert feed_b.created_job is False
+            assert feed_b.mode == SHARD_DYNAMIC  # adopted from the spec
+            for f in (feed_a, feed_b):
+                assert f.counters_snapshot()["dataservice_split_dupes"] == 0
+        finally:
+            feed_a.terminate()
+            feed_b.terminate()
+
+
+@pytest.mark.chaos(timeout=60)
+def test_consumer_death_mid_epoch_co_consumer_drains(tmp_path):
+    """A consumer crashes mid-epoch without DETACH (its streams simply go
+    quiet) while holding in-flight splits: the fence re-binds them to the
+    co-consumer, which drains the whole dataset exactly once."""
+    splits, rows = _write_jsonl(tmp_path, 8, 30)
+    with _Service(n_workers=2, heartbeat=0.2, misses=2) as svc:
+        client = DispatcherClient(svc.addr)
+        assert client.register_job("share", splits,
+                                   consumer_id="ghost")["created"]
+        # the ghost wins two splits, then crashes: no DETACH, no streams
+        assert client.request_task("share", "w0", "ghost")["splits"]
+        assert client.request_task("share", "w1", "ghost")["splits"]
+        feed = ServiceFeed(svc.addr, splits, job_name="share",
+                           consumer_id="survivor", mode=SHARD_DYNAMIC,
+                           timeout=30.0)
+        try:
+            got = _drain(feed, timeout=40.0)
+            assert sorted(got) == sorted(rows)
+            status = client.status("share")
+            assert status["done"]
+            assert status["consumers"] == 1  # ghost fenced off the job
+            assert status["reassigned"] >= 2
+            assert feed.counters_snapshot()["dataservice_split_dupes"] == 0
+        finally:
+            feed.terminate()
+            client.close()
+
+
+def test_job_state_round_trip():
+    """_Job.to_state()/from_state(): the full ledger (epoch position,
+    completion, in-flight bindings, per-consumer pend queues, attach and
+    fence sets) survives a JSON round trip."""
+    from tensorflowonspark_tpu.dataservice import _Job
+
+    job = _Job("j", ["a", "b", "c", "d"], 2, SHARD_DYNAMIC)
+    job.attach("c0")
+    job.attach("c1")
+    job.next_splits("w0", "c0", {"w0"})       # a in flight
+    job.completed.add(1)                      # b committed
+    job.pending["c1"] = [2]                   # c re-pooled for c1
+    job.fenced_consumers.add("cx")
+    job.split_errors[3] = 1
+    state = json.loads(json.dumps(job.to_state()))  # must be JSON-safe
+    clone = _Job.from_state(state)
+    assert clone.name == job.name and clone.mode == job.mode
+    assert clone.epoch == job.epoch and clone.num_epochs == job.num_epochs
+    assert clone.splits == job.splits
+    assert clone.completed == job.completed
+    assert clone.assigned == job.assigned
+    assert clone.pending == job.pending
+    assert list(clone.unassigned) == list(job.unassigned)
+    assert clone.consumers == job.consumers
+    assert clone.fenced_consumers == job.fenced_consumers
+    assert clone.split_errors == job.split_errors
+
+
+def test_journal_recovery_restores_ledger(tmp_path):
+    """Journaled dispatcher: after a simulated SIGKILL (no stop(), no
+    final snapshot) a restarted dispatcher replays the ledger — committed
+    splits stay committed, in-flight splits re-pool for their consumer,
+    and a fresh worker drains them."""
+    jdir = str(tmp_path / "journal")
+    disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1",
+                            journal_dir=jdir, snapshot_every=4)
+    addr = disp.start()
+    client = DispatcherClient(addr)
+    client.register_worker("w", "127.0.0.1", 1)
+    client.register_job("j", ["s0", "s1", "s2"], num_epochs=2,
+                        consumer_id="c0")
+    assert client.request_task("j", "w", "c0")["splits"] == [[0, "s0"]]
+    client.done_split("j", 0, 0, "c0")
+    assert client.request_task("j", "w", "c0")["splits"] == [[1, "s1"]]
+    client.close()
+    disp._stopping = True      # SIGKILL analogue: drop the socket and
+    disp._socket.close()       # leave the journal tail as-is
+    disp2 = DispatcherServer(heartbeat_interval=0, host="127.0.0.1",
+                             journal_dir=jdir)
+    addr2 = disp2.start()
+    try:
+        assert disp2.recovered_jobs == 1
+        client = DispatcherClient(addr2)
+        status = client.status("j")
+        assert status["completed"] == 1
+        assert status["assigned"] == 0 and status["pending"] == 1
+        assert status["consumers"] == 1
+        client.register_worker("w2", "127.0.0.1", 2)
+        assert client.request_task("j", "w2", "c0")["splits"] == [[1, "s1"]]
+        client.close()
+    finally:
+        disp2.stop()
+
+
+@pytest.mark.chaos(timeout=90)
+def test_dispatcher_crash_restart_mid_job_exactly_once(tmp_path):
+    """The journal tentpole e2e: the dispatcher is crashed mid-job (socket
+    dropped, no BYE, no snapshot flush) and restarted on the same port
+    from the journal; workers re-register off the heartbeat hint, the
+    consumer's maintainer reconnects, and the drain still delivers every
+    element exactly once."""
+    jdir = str(tmp_path / "journal")
+    datadir = tmp_path / "data"
+    datadir.mkdir()
+    splits, rows = _write_jsonl(datadir, 10, 40)
+    disp = DispatcherServer(heartbeat_interval=0.2, heartbeat_misses=3,
+                            host="127.0.0.1", journal_dir=jdir,
+                            snapshot_every=8)
+    addr = disp.start()
+    port = addr[1]
+    workers = [FeedWorker(addr, row_reader=data.jsonl_rows,
+                          worker_id="w{}".format(i),
+                          heartbeat_interval=0.2).start()
+               for i in range(2)]
+    feed = ServiceFeed(addr, splits, job_name="crash",
+                       mode=SHARD_DYNAMIC, timeout=60.0)
+    restarted = {}
+
+    def crash_and_restart():
+        deadline = time.monotonic() + 20
+        while (sum(w.splits_streamed for w in workers) < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        disp._stopping = True
+        disp._socket.close()
+        d2 = DispatcherServer(heartbeat_interval=0.2, heartbeat_misses=3,
+                              host="127.0.0.1", port=port,
+                              journal_dir=jdir, snapshot_every=8)
+        d2.start()
+        restarted["disp"] = d2
+
+    t = threading.Thread(target=crash_and_restart, daemon=True)
+    t.start()
+    try:
+        got = _drain(feed, timeout=60.0)
+        t.join(timeout=15)
+        assert "disp" in restarted, "dispatcher never restarted"
+        # elements exactly once — the (epoch, split) dedupe absorbs any
+        # split whose DONE was in flight when the dispatcher died
+        assert sorted(got) == sorted(rows)
+        assert restarted["disp"].recovered_jobs == 1
+        client = DispatcherClient(("127.0.0.1", port))
+        assert client.status("crash")["done"]
+        client.close()
+    finally:
+        feed.terminate()
+        for w in workers:
+            w.stop()
+        if "disp" in restarted:
+            restarted["disp"].stop()
+
+
+def test_affinity_prefers_cache_holder_unit():
+    """The 3-tier DYNAMIC pick: a worker gets its own cached splits first,
+    a cache-cold worker is steered to splits cached nowhere (so it never
+    poaches another worker's warm split while cold ones remain), and the
+    FCFS head is the never-stall fallback."""
+    from tensorflowonspark_tpu.dataservice import _Job
+
+    job = _Job("j", ["a", "b", "c", "d"], 1, SHARD_DYNAMIC)
+    job.attach("c0")
+    caches = {"w1": {"c", "d"}, "w2": set()}
+
+    def grab(worker):
+        out = job.next_splits(worker, "c0", {"w1", "w2"},
+                              worker_caches=caches, affinity=True)
+        return out["splits"][0][1] if out and out["splits"] else None
+
+    assert grab("w2") == "a"   # cold worker → split cached nowhere
+    assert grab("w1") == "c"   # cache holder → its own splits first
+    assert grab("w1") == "d"
+    assert grab("w2") == "b"
+    assert job.affinity_hits == 2 and job.affinity_total == 4
+
+    # re-pooled splits are re-handed with the same preference
+    job2 = _Job("j2", ["a", "b", "c"], 1, SHARD_DYNAMIC)
+    job2.attach("c0")
+    job2.unassigned = []
+    job2.pending["c0"] = [0, 2]
+    out = job2.next_splits("w1", "c0", {"w1"},
+                           worker_caches={"w1": {"c"}}, affinity=True)
+    assert out["splits"][0] == [2, "c"]
+
+    # affinity off: plain FCFS, but the hit/total tally still runs so an
+    # affinity-off A/B leg reports its (lower) would-be hit rate
+    job3 = _Job("j3", ["a", "b"], 1, SHARD_DYNAMIC)
+    job3.attach("c0")
+    out = job3.next_splits("w1", "c0", {"w1"},
+                           worker_caches={"w1": {"b"}}, affinity=False)
+    assert out["splits"][0] == [0, "a"]
+    assert job3.affinity_total == 1 and job3.affinity_hits == 0
+
+
+def test_affinity_e2e_second_job_hits_cache(tmp_path):
+    """Affinity end to end: job 1 fills two worker caches, the heartbeat
+    advertises them, and job 2's DYNAMIC hand-outs steer splits back to
+    their cache holders — visible in the job status and in the consumer's
+    counter snapshot."""
+    splits, rows = _write_jsonl(tmp_path, 6, 20, row_fn=_payload_row)
+    with _Service(n_workers=2, cache_bytes=32 << 20) as svc:
+        feed1 = ServiceFeed(svc.addr, splits, job_name="warmup",
+                            mode=SHARD_DYNAMIC, timeout=30.0)
+        assert sorted(_drain_ids(feed1)) == sorted(r[0] for r in rows)
+        feed1.terminate()
+        deadline = time.monotonic() + 5
+        while sum(len(v) for v in
+                  svc.dispatcher._worker_cache.values()) < len(splits):
+            assert time.monotonic() < deadline, "cache never advertised"
+            time.sleep(0.05)
+        feed2 = ServiceFeed(svc.addr, splits, job_name="warm",
+                            mode=SHARD_DYNAMIC, timeout=30.0)
+        try:
+            assert sorted(_drain_ids(feed2)) == sorted(r[0] for r in rows)
+            client = DispatcherClient(svc.addr)
+            status = client.status("warm")
+            client.close()
+            assert status["affinity_total"] == len(splits)
+            assert status["affinity_hits"] >= 1
+            snap = feed2.counters_snapshot()
+            assert snap["dataservice_cache_hit"] > 0
+            assert snap["dataservice_affinity_total"] == len(splits)
+            assert snap["dataservice_affinity_hits"] == \
+                status["affinity_hits"]
+            assert 0 < snap["dataservice_affinity_hit_pct_max"] <= 100.0
+        finally:
+            feed2.terminate()
+
+
+def test_frame_cache_spill_bytes_and_cached_paths(tmp_path):
+    """Spill accounting and the advertisement view: spilled bytes tally
+    (and drain once via take_spill_bytes for the per-split report), and
+    cached_paths() lists resident AND spilled sources — a spilled entry
+    is still a cheap local re-serve, so affinity should still steer to
+    it."""
+    from tensorflowonspark_tpu.dataservice import _FrameCache
+
+    cache = _FrameCache(max_bytes=150, spill_dir=str(tmp_path / "spill"))
+    cache.put("a", "zlib", None, _frames(100))
+    cache.put("b", "zlib", None, _frames(100))  # a evicts → spills to disk
+    assert cache.spills == 1
+    assert cache.spill_bytes >= 100
+    assert cache.cached_paths() == ["a", "b"]
+    taken = cache.take_spill_bytes()
+    assert taken == cache.spill_bytes
+    assert cache.take_spill_bytes() == 0        # drained exactly once
+    flat = cache.counters_flat()
+    assert flat["dataservice_cache_spill_bytes"] == cache.spill_bytes

@@ -155,7 +155,8 @@ class TestRuleEngine:
         to turn; a hit-dominated peer stays silent."""
         eng = watchtower.RuleEngine()
         thrash = [(T0 + i, {"dataservice_cache_evictions": i * 5,
-                            "dataservice_cache_hit": i})
+                            "dataservice_cache_hit": i,
+                            "dataservice_cache_spill_bytes": i * 1000})
                   for i in range(1, 7)]
         healthy = [(T0 + i, {"dataservice_cache_evictions": 0,
                              "dataservice_cache_hit": i * 10})
@@ -167,6 +168,8 @@ class TestRuleEngine:
         assert a["evictions"] == 25 and a["hits"] == 5
         assert a["value"] >= eng.config["cache_thrash_evict_hit_ratio"]
         assert "cache_bytes" in a["message"]
+        # spill traffic in the window rides along as evidence
+        assert a["spill_bytes"] == 5000 and "5000 B spilled" in a["message"]
         # a cache-less window (no counters at all) never trips the rule
         assert eng.evaluate({"0": _beats(6)}, now=T0 + 6) == []
 

@@ -61,7 +61,11 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # SIGKILLed and restarted mid-run on the same port, exact element totals
 # with zero duplicates across the crash, and nonzero
 # tfos_dataservice_cache_hit_total plus the affinity hit-rate on a live
-# /metrics scrape
+# /metrics scrape, and finally prove the autopilot closes the loop live:
+# a 2-node cluster with prefetch pinned low gets its depth raised by the
+# controller mid-run, the measured starvation wall-fraction drops, every
+# action lands in the journal and on /autopilot, and metrics_replay.py
+# re-derives the action stream offline
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -74,5 +78,6 @@ python scripts/ci_assert_watchtower.py
 python scripts/ci_assert_serving.py
 python scripts/ci_assert_warmstart.py
 python scripts/ci_assert_shared.py
+python scripts/ci_assert_autopilot.py
 
 exit $rc

@@ -312,6 +312,11 @@ HEADLINE_METRICS = (
     ("warm_start_cold_secs", "warm_start", "lower"),
     ("warm_start_warm_secs", "warm_start", "lower"),
     ("warm_start_speedup", "warm_start", "higher"),
+    # autopilot controller (absent pre-round-14, skipped by run_diff)
+    ("autopilot_convergence_frac", "autopilot_convergence", "higher"),
+    ("autopilot_items_per_sec", "autopilot_convergence", "higher"),
+    ("autopilot_hand_tuned_items_per_sec", "autopilot_convergence",
+     "higher"),
 )
 
 

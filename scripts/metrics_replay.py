@@ -1,5 +1,5 @@
-"""Replay a watchtower metrics journal offline: re-derive alerts, render a
-per-node timeline.
+"""Replay a watchtower or autopilot journal offline: re-derive the
+alert/action stream, render a per-node timeline.
 
 The live watchtower journals periodic ``metrics_snapshot()`` records and
 every alert it fired into an append-only JSONL under
@@ -10,11 +10,24 @@ start straggling, and would today's thresholds have caught it" without a
 live scrape window — and threshold changes can be evaluated against
 recorded history (``--config``) before they ship.
 
+An **autopilot** journal (``<log_dir>/autopilot/journal.jsonl``) is
+detected automatically (``--kind`` overrides): the controller's decision
+logic (:func:`tensorflowonspark_tpu.autopilot.replay_journal`) is re-run
+dry over the journaled snapshots, the live action stream
+(proposed → applied → effect → kept/reverted) is printed, and the
+live-vs-replay divergence — proposals the live run made that the replay
+does not re-derive, and vice versa — is reported.  Divergence is expected
+exactly where the live run ACTED: actuation changes the telemetry the
+replay's snapshots recorded, so a kept action's follow-up proposals can
+differ.  Config overrides answer "what would the controller have done at
+other thresholds" against recorded history.
+
 Usage:
   python scripts/metrics_replay.py <journal.jsonl>            # human report
   python scripts/metrics_replay.py <journal.jsonl> --json     # machine doc
   python scripts/metrics_replay.py j.jsonl --config '{"straggler_z": 3}'
   python scripts/metrics_replay.py j.jsonl --keys dispatch_count,infeed_batches
+  python scripts/metrics_replay.py autopilot/journal.jsonl    # autodetected
 
 Exit status: 0 on a clean replay, 2 when the journal has no snapshot
 records (nothing to evaluate).
@@ -44,6 +57,92 @@ def _fmt(v):
             return repr(v)
         return "%.4g" % v
     return str(v)
+
+
+def detect_kind(records):
+    """``"autopilot"`` or ``"watchtower"`` from the journal's own records:
+    the autopilot meta carries a ``knobs`` map and its stream is ``action``
+    records; the watchtower's is ``alert`` records."""
+    for rec in records:
+        if rec.get("kind") == "meta":
+            return "autopilot" if "knobs" in rec else "watchtower"
+    for rec in records:
+        if rec.get("kind") == "action":
+            return "autopilot"
+        if rec.get("kind") == "alert":
+            return "watchtower"
+    return "watchtower"
+
+
+def _proposals(actions):
+    """The comparable decision set: ``(knob, to)`` of every proposal —
+    replay runs dry, so only the proposed stage exists on both sides."""
+    return {(a.get("knob"), str(a.get("to"))) for a in actions
+            if a.get("stage") == "proposed"}
+
+
+def autopilot_report(args, records, overrides):
+    from tensorflowonspark_tpu import autopilot
+
+    result = autopilot.replay_journal(records, config=overrides)
+    journaled = result["journaled_actions"]
+    replayed = result["actions"]
+    live, rep = _proposals(journaled), _proposals(replayed)
+    divergence = {"live_only": sorted(live - rep),
+                  "replay_only": sorted(rep - live)}
+
+    if args.json:
+        json.dump({"kind": "autopilot", "journal": args.journal,
+                   "snapshots": result["snapshots"],
+                   "config": result["config"],
+                   "journaled_actions": journaled,
+                   "replayed_actions": replayed,
+                   "divergence": divergence}, sys.stdout, default=str)
+        print()
+        return 0 if result["snapshots"] else 2
+
+    print("journal: %s (autopilot)" % args.journal)
+    print("snapshot records: %d, journaled actions: %d, "
+          "replayed proposals: %d"
+          % (result["snapshots"], len(journaled), len(replayed)))
+    t0 = min((r.get("time", 0.0) for r in records
+              if r.get("kind") in ("snapshot", "action")), default=0.0)
+    if journaled:
+        print("\nlive action stream:")
+        for a in journaled:
+            eff = ""
+            if a.get("stage") in ("effect", "kept", "reverted"):
+                eff = "  objective %s -> %s" % (
+                    _fmt(a.get("objective_before")),
+                    _fmt(a.get("objective_after")))
+            print("  [t+%7.1fs] #%-3s %-9s %-24s %s -> %s (%s)%s"
+                  % (a.get("time", 0.0) - t0, a.get("seq"), a.get("stage"),
+                     a.get("knob"), _fmt(a.get("from")), _fmt(a.get("to")),
+                     a.get("signal"), eff))
+    else:
+        print("\nno actions journaled by the live run")
+    if replayed:
+        print("\nreplay-derived proposals (decision logic re-run dry):")
+        for a in replayed:
+            print("  [t+%7.1fs] %-24s %s -> %s (%s)"
+                  % (a.get("time", 0.0) - t0, a.get("knob"),
+                     _fmt(a.get("from")), _fmt(a.get("to")),
+                     a.get("signal")))
+    else:
+        print("\nno proposals re-derived at these thresholds")
+    if divergence["live_only"]:
+        print("\nproposed live but not re-derived (actuation changed the "
+              "telemetry the replay reads, or config overrides): %s"
+              % divergence["live_only"])
+    if divergence["replay_only"]:
+        print("re-derived but never proposed live: %s"
+              % divergence["replay_only"])
+    if not divergence["live_only"] and not divergence["replay_only"]:
+        print("\nlive and replay decision streams agree")
+    if not result["snapshots"]:
+        print("no snapshot records: nothing to evaluate", file=sys.stderr)
+        return 2
+    return 0
 
 
 def build_timeline(records, result, keys):
@@ -101,12 +200,19 @@ def render_table(rows, keys):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description="Re-run the watchtower rule engine over a metrics "
-                    "journal and render a per-node timeline.")
-    ap.add_argument("journal", help="path to watchtower journal.jsonl")
+        description="Re-run the watchtower rule engine (or the autopilot "
+                    "decision logic) over a metrics journal and render a "
+                    "per-node timeline / action stream.")
+    ap.add_argument("journal",
+                    help="path to a watchtower or autopilot journal.jsonl")
+    ap.add_argument("--kind", choices=("auto", "watchtower", "autopilot"),
+                    default="auto",
+                    help="journal flavor (default: detect from the meta "
+                         "record)")
     ap.add_argument("--config", default=None,
                     help="JSON dict of rule-config overrides "
-                         "(see watchtower.DEFAULT_CONFIG)")
+                         "(see watchtower.DEFAULT_CONFIG / "
+                         "autopilot.DEFAULT_CONFIG)")
     ap.add_argument("--keys", default=",".join(DEFAULT_KEYS),
                     help="comma-separated counter keys for the timeline "
                          "columns (default: %(default)s)")
@@ -121,6 +227,9 @@ def main(argv=None):
     keys = tuple(k for k in args.keys.split(",") if k)
 
     records = watchtower.read_journal(args.journal)
+    kind = args.kind if args.kind != "auto" else detect_kind(records)
+    if kind == "autopilot":
+        return autopilot_report(args, records, overrides)
     result = watchtower.replay_journal(records, config=overrides)
     rows = build_timeline(records, result, keys)
     if args.limit:

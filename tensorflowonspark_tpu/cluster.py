@@ -45,7 +45,7 @@ class TPUCluster(object):
 
     def __init__(self, backend, cluster_meta, cluster_info, input_mode,
                  server, start_job, tf_status, queues, observatory=None,
-                 profiling=None, watchtower=None):
+                 profiling=None, watchtower=None, autopilot=None):
         self.backend = backend
         self.cluster_meta = cluster_meta
         self.cluster_info = cluster_info
@@ -67,6 +67,11 @@ class TPUCluster(object):
         # stopped before the observatory so the final journal flush and
         # alert-count latch land in tf_status (see _latch_telemetry)
         self.watchtower = watchtower
+        # optional autopilot.Autopilot (cluster.run(autopilot=True)): the
+        # closed-loop performance controller; stopped FIRST on shutdown so
+        # its final journal snapshot and action tallies precede the
+        # watchtower/observatory teardown (see _latch_telemetry)
+        self.autopilot = autopilot
 
     # -- data plane -------------------------------------------------------
 
@@ -278,6 +283,17 @@ class TPUCluster(object):
                 self.tf_status.setdefault("telemetry", snap)
         except Exception:
             logger.debug("telemetry latch failed", exc_info=True)
+        if self.autopilot is not None:
+            # stop the controller before the rule engine that feeds it
+            # hints: the final journal snapshot and the action tallies
+            # belong in tf_status next to the telemetry latch
+            try:
+                self.autopilot.stop()
+                counts = self.autopilot.action_counts()
+                if counts:
+                    self.tf_status.setdefault("autopilot", counts)
+            except Exception:
+                logger.debug("autopilot stop failed", exc_info=True)
         if self.watchtower is not None:
             # stop the rule engine first: its final tick + journal flush
             # must see the closing metrics, and the alert tallies belong in
@@ -532,7 +548,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         driver_ps_nodes=False, heartbeat_interval=5.0, heartbeat_misses=3,
         telemetry=False, telemetry_dir=None, data_service=None,
         observatory=False, observatory_port=0, watchtower=None,
-        compile_cache_dir=None):
+        autopilot=False, compile_cache_dir=None):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -598,6 +614,18 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         journal at ``<log_dir>/watchtower/journal.jsonl`` (replayable
         offline via ``scripts/metrics_replay.py``).  Suspect-node
         verdicts land in ``tf_status["suspects"]``.
+      autopilot: closed-loop performance controller over the observatory's
+        sample ring (see :mod:`~tensorflowonspark_tpu.autopilot`; requires
+        ``observatory=True``): ``False`` (default) off, ``True`` on with
+        defaults, a dict overrides controller/knob settings key-wise (see
+        ``autopilot.DEFAULT_CONFIG``; ``{"dry_run": True}`` journals
+        proposals without actuating).  Actuation rides the heartbeat-reply
+        channel into per-node live setters (infeed prefetch depth,
+        data-service queue bound / cache budget / wire codec, gateway
+        batching).  Every action is journaled to
+        ``<log_dir>/autopilot/journal.jsonl`` and surfaces on ``GET
+        /autopilot`` plus ``tfos_autopilot_*`` counters on ``/metrics``.
+        See docs/AUTOPILOT.md.
       compile_cache_dir: warm-start compile plane
         (:mod:`~tensorflowonspark_tpu.compilecache`): every node points
         JAX's persistent compilation cache at this cluster-shared
@@ -742,6 +770,10 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
     obs = None
     profiling_coord = None
     wt = None
+    pilot = None
+    if autopilot and not observatory:
+        raise ValueError("autopilot= requires observatory=True: the "
+                         "controller reads the observatory's sample ring")
     if observatory:
         from tensorflowonspark_tpu import observatory as observatory_mod
         from tensorflowonspark_tpu import profiling as profiling_mod
@@ -759,6 +791,39 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
             server, os.path.abspath(
                 os.path.join(log_dir or ".", "profiles")))
         server.profile_coordinator = profiling_coord
+
+        if autopilot:
+            from tensorflowonspark_tpu import autopilot as autopilot_mod
+
+            # Actuation plane: knob pushes fan out through the
+            # heartbeat-reply channel (the PROF/reregister pattern) — each
+            # node drains its unseen pushes exactly once per beat and
+            # applies the namespaced knobs its registered feeds claim
+            # (node.apply_knobs); unclaimed names are ignored, so one
+            # broadcast serves trainers, gateways, and worker relays alike.
+            server.knob_coordinator = reservation.KnobCoordinator()
+            ap_config = dict(autopilot) if isinstance(autopilot, dict) else {}
+            ap_knobs = {k: dict(v)
+                        for k, v in (ap_config.get("knobs") or {}).items()}
+            ap_knobs.setdefault("infeed_prefetch", {})
+            if "initial" not in ap_knobs["infeed_prefetch"]:
+                # seed the controller with the fleet's actual starting depth
+                # so the first retune doubles from reality, not a guess
+                try:
+                    ap_knobs["infeed_prefetch"]["initial"] = max(
+                        int(os.environ.get("TFOS_INFEED_PREFETCH", "2")), 1)
+                except ValueError:
+                    ap_knobs["infeed_prefetch"]["initial"] = 2
+            ap_config["knobs"] = ap_knobs
+            pilot = autopilot_mod.Autopilot(
+                ring, actuator=server.knob_coordinator.push,
+                snapshot_fn=server.metrics_snapshot,
+                config=ap_config,
+                journal_path=os.path.abspath(os.path.join(
+                    log_dir or ".", "autopilot", "journal.jsonl")))
+            pilot.start()
+            logger.info("autopilot engaged (dry_run=%s), journal at %s",
+                        pilot.config["dry_run"], pilot.journal_path)
 
         def _profiler_addresses():
             # lazy: the observatory starts before the roster exists, and the
@@ -782,7 +847,9 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                 config=watchtower if isinstance(watchtower, dict) else None,
                 journal_path=os.path.abspath(os.path.join(
                     log_dir or ".", "watchtower", "journal.jsonl")),
-                on_suspect=_on_suspect, beat_ages_fn=server.beat_ages)
+                on_suspect=_on_suspect, beat_ages_fn=server.beat_ages,
+                on_alert=(pilot.observe_alert if pilot is not None
+                          else None))
             wt.start()
             # Flight records (SIGUSR1 / stall dumps) now carry the metric
             # trajectory and alert log leading into the stall.
@@ -796,7 +863,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
             profile_fn=profiling_coord.trigger,
             profiler_addresses_fn=_profiler_addresses,
             capture_status_fn=profiling_coord.status,
-            watchtower=wt)
+            watchtower=wt, autopilot=pilot)
         addr = obs.start()
         logger.info("observatory serving /metrics, /status, /profile and "
                     "/alerts at http://%s:%d", addr[0], addr[1])
@@ -916,4 +983,4 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
     return TPUCluster(cluster_backend, cluster_meta, cluster_info, input_mode,
                       server, start_job, tf_status, tuple(queues),
                       observatory=obs, profiling=profiling_coord,
-                      watchtower=wt)
+                      watchtower=wt, autopilot=pilot)

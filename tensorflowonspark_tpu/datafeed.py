@@ -167,10 +167,26 @@ class DataFeed(object):
         # another thread can take over queue consumption (the queue/ring is
         # single-consumer; see ShardedFeed.terminate).
         self._interrupt = threading.Event()
+        # Queue-poll cadence of the interruptible blocking get; a live knob
+        # (``feed_poll_secs``) because it trades idle-CPU wakeups against
+        # interrupt latency and the right value depends on measured load.
+        self._poll_secs = 0.5
         # Chaos hook: consumption-side fault injection ("node dies / fails
         # after N items") — a null object unless TFOS_FAULT_SPEC targets
         # this process (see tensorflowonspark_tpu.fault).
         self._fault = fault.from_env()
+
+    def apply_knob(self, name, value):
+        """Live-knob hook — the duck-typed protocol every registered feed
+        source shares (see ``node.apply_knobs`` and docs/AUTOPILOT.md):
+        claim a ``{knob: value}`` push by returning True, return False for
+        names that belong to other planes.  The queue-backed DataFeed owns
+        just ``feed_poll_secs``; richer feeds (ShardedFeed, ServiceFeed)
+        claim the autopilot's performance knobs."""
+        if name == "feed_poll_secs":
+            self._poll_secs = min(max(float(value), 0.05), 5.0)
+            return True
+        return False
 
     def next_batch(self, batch_size):
         """Get up to ``batch_size`` items from the input queue.
@@ -266,7 +282,7 @@ class DataFeed(object):
         try:
             while not self._interrupt.is_set():
                 try:
-                    return queue.get(block=True, timeout=0.5)
+                    return queue.get(block=True, timeout=self._poll_secs)
                 except _queue.Empty:
                     continue
             return _INTERRUPTED

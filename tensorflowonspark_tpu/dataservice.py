@@ -96,7 +96,7 @@ import numpy as np
 
 from tensorflowonspark_tpu import fault, marker, telemetry, transport, wire
 from tensorflowonspark_tpu.reservation import (
-    Client, HeartbeatSender, MessageSocket)
+    Client, HeartbeatSender, KnobCoordinator, MessageSocket)
 
 logger = logging.getLogger(__name__)
 
@@ -541,7 +541,7 @@ class DispatcherServer(MessageSocket):
 
     def __init__(self, heartbeat_interval=1.0, heartbeat_misses=3,
                  host=None, port=0, journal_dir=None, snapshot_every=None,
-                 affinity=None):
+                 affinity=None, journal_keep=None, journal_keep_bytes=None):
         self.heartbeat_interval = heartbeat_interval
         self.heartbeat_misses = heartbeat_misses
         self._host = host
@@ -552,6 +552,18 @@ class DispatcherServer(MessageSocket):
         if snapshot_every is None:
             snapshot_every = _env_int("TFOS_DS_SNAPSHOT_EVERY", 512)
         self.snapshot_every = max(int(snapshot_every), 1)
+        # Compaction policy: keep the newest ``journal_keep`` generations
+        # (snapshot + its segment; the historic hardcoded default was 2),
+        # or — when ``journal_keep_bytes`` is set — as many newest
+        # generations as fit the byte budget (week-long shared jobs want
+        # "a disk budget", not "a count"; the newest generation is always
+        # kept regardless).
+        if journal_keep is None:
+            journal_keep = _env_int("TFOS_DS_JOURNAL_KEEP", 2)
+        self.journal_keep = max(int(journal_keep), 1)
+        if journal_keep_bytes is None:
+            journal_keep_bytes = _env_int("TFOS_DS_JOURNAL_KEEP_BYTES", 0)
+        self.journal_keep_bytes = max(int(journal_keep_bytes), 0)
         if affinity is None:
             affinity = _env_flag("TFOS_DS_AFFINITY", True)
         self.affinity = bool(affinity)
@@ -562,6 +574,12 @@ class DispatcherServer(MessageSocket):
         self._worker_metrics = {}  # worker_id -> latest HBEAT counters
         self._worker_cache = {}    # worker_id -> cached source-path set
         self._consumer_seen = {}   # (job, consumer) -> last contact
+        # Live-knob fan-out to workers: the driver-side autopilot can't
+        # reach FeedWorkers directly (they beat HERE, not to the
+        # reservation server), so a KNOB message queues updates that ride
+        # out on worker HBEAT replies exactly-once (the same coordinator
+        # the reservation server uses for training nodes).
+        self.knobs = KnobCoordinator()
         self._journal_file = None
         self._journal_seq = 0
         self._journal_count = 0
@@ -654,10 +672,41 @@ class DispatcherServer(MessageSocket):
                          seq, e)
             self._journal_file = None
         self._journal_count = 0
-        for old in range(seq - 2):
+        self._prune_segments(seq)
+
+    def _gen_bytes(self, seq):
+        """On-disk bytes of one generation (snapshot + journal segment)."""
+        total = 0
+        for kind in ("snapshot", "journal"):
+            try:
+                total += os.path.getsize(self._segment_path(kind, seq))
+            except OSError:
+                pass
+        return total
+
+    def _prune_segments(self, seq):
+        """Apply the compaction policy after cutting generation ``seq``.
+
+        Byte budget (``journal_keep_bytes`` > 0): keep the newest
+        generations whose cumulative on-disk size fits the budget — the
+        newest is always kept even when it alone overflows.  Otherwise:
+        keep the newest ``journal_keep`` generations.  Everything older
+        is unlinked."""
+        if self.journal_keep_bytes:
+            keep = {seq}
+            total = self._gen_bytes(seq)
+            for s in range(seq - 1, 0, -1):
+                total += self._gen_bytes(s)
+                if total > self.journal_keep_bytes:
+                    break
+                keep.add(s)
+            oldest_kept = min(keep)
+        else:
+            oldest_kept = seq - self.journal_keep + 1
+        for old in range(1, oldest_kept):
             for kind in ("snapshot", "journal"):
                 try:
-                    os.unlink(self._segment_path(kind, old + 1))
+                    os.unlink(self._segment_path(kind, old))
                 except OSError:
                     pass
 
@@ -994,6 +1043,15 @@ class DispatcherServer(MessageSocket):
                             # worker: tell it to re-register (WREG) so it
                             # re-enters the roster with its data address
                             reply["reregister"] = True
+                        # live-knob fan-out: pending KNOB pushes ride the
+                        # beat reply exactly-once per worker
+                        try:
+                            pending = self.knobs.poll(worker_id)
+                        except Exception:
+                            logger.exception("worker knob poll failed")
+                            pending = None
+                        if pending:
+                            reply["knobs"] = pending
                     self.send(sock, reply)
             elif mtype == "BYE":
                 worker_id = data.get("executor_id")
@@ -1025,6 +1083,21 @@ class DispatcherServer(MessageSocket):
                 self.send(sock, {"type": "WORKERS",
                                  "data": sorted(self._workers.values(),
                                                 key=lambda m: m["worker_id"])})
+            elif mtype == "KNOB":
+                # queue a {knob: value} update for the worker fleet (or one
+                # worker_id); delivery rides the next HBEAT replies.  Sent
+                # by ServiceFeed.apply_knob relaying autopilot pushes.
+                knobs = data.get("knobs")
+                if not isinstance(knobs, dict) or not knobs:
+                    self.send(sock, {"type": "ERR",
+                                     "error": "KNOB without a knobs dict"})
+                else:
+                    seq = self.knobs.push(knobs,
+                                          executor_id=data.get("worker_id"))
+                    telemetry.get_tracer().instant(
+                        "dataservice/knob", knobs=",".join(sorted(knobs)),
+                        seq=seq)
+                    self.send(sock, {"type": "OK", "seq": seq})
             elif mtype == "TASK":
                 job = self._jobs.get(data.get("job"))
                 worker_id = data.get("worker_id")
@@ -1318,6 +1391,15 @@ class DispatcherClient(Client):
         return self._call("DETACH", {"job": name,
                                      "consumer_id": consumer_id})
 
+    def push_knobs(self, knobs, worker_id=None):
+        """Queue a live-knob ``{name: value}`` update for the worker fleet
+        (or one ``worker_id``); delivery rides the workers' next heartbeat
+        replies exactly-once (see docs/AUTOPILOT.md)."""
+        data = {"knobs": dict(knobs)}
+        if worker_id is not None:
+            data["worker_id"] = worker_id
+        return self._call("KNOB", data).get("seq")
+
     def workers(self):
         """Live worker roster as a list of ``{worker_id, host, port}``."""
         return self._call("WORKERS").get("data") or []
@@ -1514,6 +1596,20 @@ class _FrameCache(object):
             self.evictions += 1
             if self._spill_entry(key, entry):
                 self.spills += 1
+
+    def set_max_bytes(self, max_bytes):
+        """Live budget retune (autopilot ``dataservice_cache_budget``
+        knob): a raise takes effect on the next insert; a shrink evicts
+        down to the new budget immediately (spilling per the usual rules).
+        The spill budget keeps its 4× ratio unless it was set explicitly.
+        """
+        max_bytes = int(max_bytes)
+        with self._lock:
+            grew_spill = self.spill_budget == 4 * self.max_bytes
+            self.max_bytes = max_bytes
+            if grew_spill:
+                self.spill_budget = 4 * max_bytes
+            self._evict_overflow()
 
     # -- serve-thread API --------------------------------------------------
 
@@ -1753,7 +1849,21 @@ class FeedWorker(object):
         """A heartbeat answer carrying ``reregister`` means the dispatcher
         restarted and has never seen this worker: re-send WREG (throttled
         to one attempt per heartbeat interval; best-effort — the next beat
-        retries).  Runs on the heartbeat thread."""
+        retries).  A ``knobs`` dict is a live-knob push relayed through
+        the dispatcher (autopilot ``dataservice_cache_budget``): applied
+        inline — a budget retune is a bounded eviction pass.  Runs on the
+        heartbeat thread."""
+        knobs = resp.get("knobs")
+        if isinstance(knobs, dict):
+            budget = knobs.get("dataservice_cache_budget")
+            if budget is not None and self.chunk_cache is not None:
+                try:
+                    self.chunk_cache.set_max_bytes(budget)
+                    logger.info("feed worker %s: cache budget retuned to "
+                                "%d bytes", self.worker_id, int(budget))
+                except Exception:
+                    logger.warning("feed worker %s: cache budget knob "
+                                   "failed", self.worker_id, exc_info=True)
         if not resp.get("reregister") or self._stop.is_set():
             return
         now = time.monotonic()
@@ -2828,6 +2938,9 @@ class ServiceFeed(object):
             if cap:
                 snap["dataservice_queue_sat_pct_max"] = round(
                     100.0 * self._chunks.qsize() / cap, 2)
+                # gauge: the CURRENT bound, so the driver can confirm a
+                # live autopilot retune landed
+                snap["dataservice_queue_bound_max"] = cap
         except Exception:
             pass
         for fmt, n in list(self.wire_formats.items()):
@@ -2857,3 +2970,57 @@ class ServiceFeed(object):
             snap["wire_compress_ratio_max"] = round(_metrics.compression_ratio(
                 self.compress_raw_bytes, self.compress_wire_bytes), 4)
         return snap
+
+    def apply_knob(self, name, value):
+        """Live-knob hook (autopilot KNOB pushes; see docs/AUTOPILOT.md).
+
+        - ``dataservice_queue_bound``: rebounds the RUNNING chunk queue in
+          place (under its mutex, waking blocked putters), so receiver
+          threads can buffer deeper on the very next frame.
+        - ``wire_codec``: re-resolves the codec offer (``"off"`` offers
+          nothing, ``"auto"`` re-resolves the host default, a codec name
+          offers just it); negotiated per stream hello, so it affects
+          future dials — late-joining workers, re-dials, the next feed.
+        - ``dataservice_cache_budget``: relayed to the dispatcher as a
+          KNOB message (on a short-lived thread — this hook runs on the
+          node's heartbeat thread) to ride the worker heartbeat replies.
+
+        Returns True when the knob was claimed."""
+        if name == "dataservice_queue_bound":
+            bound = max(int(value), 2)
+            q = self._chunks
+            with q.mutex:
+                q.maxsize = bound
+                q.not_full.notify_all()
+            return True
+        if name == "wire_codec":
+            if value in (None, "auto"):
+                self.codecs = _resolve_codecs(None)
+            elif str(value).lower() in ("off", "0", "none", "pickle"):
+                self.codecs = []
+            elif wire.codec_supported(str(value)):
+                self.codecs = [str(value)]
+            else:
+                logger.warning("wire_codec knob: %r unsupported on this "
+                               "host; ignored", value)
+                return False
+            return True
+        if name == "dataservice_cache_budget":
+            budget = int(value)
+
+            def _relay():
+                try:
+                    client = DispatcherClient(self.dispatcher_addr,
+                                              retries=0)
+                    try:
+                        client.push_knobs(
+                            {"dataservice_cache_budget": budget})
+                    finally:
+                        client.close()
+                except Exception as e:
+                    logger.warning("cache-budget knob relay failed (%s)", e)
+
+            threading.Thread(target=_relay, name="tfos-knob-relay",
+                             daemon=True).start()
+            return True
+        return False

@@ -13,11 +13,13 @@ Usage::
     python -m tensorflowonspark_tpu.dataservice_dispatcher \\
         [--host H] [--port P] [--heartbeat SECS] [--misses N] \\
         [--journal-dir DIR] [--snapshot-every N] \\
+        [--journal-keep N | --journal-keep-bytes N] \\
         [--affinity | --no-affinity]
 
 Env fallbacks (flags win): ``TFOS_DS_JOURNAL_DIR``,
-``TFOS_DS_SNAPSHOT_EVERY``, ``TFOS_DS_AFFINITY`` — the same shape as the
-worker CLI's ``TFOS_DS_CACHE_BYTES``.
+``TFOS_DS_SNAPSHOT_EVERY``, ``TFOS_DS_JOURNAL_KEEP``,
+``TFOS_DS_JOURNAL_KEEP_BYTES``, ``TFOS_DS_AFFINITY`` — the same shape as
+the worker CLI's ``TFOS_DS_CACHE_BYTES``.
 """
 
 import argparse
@@ -46,6 +48,14 @@ def main(argv=None):
     parser.add_argument("--snapshot-every", type=int, default=None,
                         help="journal records between full snapshots "
                              "(default: TFOS_DS_SNAPSHOT_EVERY env, 512)")
+    parser.add_argument("--journal-keep", type=int, default=None,
+                        help="snapshot generations kept after compaction "
+                             "(default: TFOS_DS_JOURNAL_KEEP env, 2)")
+    parser.add_argument("--journal-keep-bytes", type=int, default=None,
+                        help="byte budget for retired generations instead "
+                             "of a count; the newest generation is always "
+                             "kept (default: TFOS_DS_JOURNAL_KEEP_BYTES "
+                             "env, 0 = use --journal-keep)")
     parser.add_argument("--affinity", dest="affinity", action="store_true",
                         default=None,
                         help="cache-affinity DYNAMIC scheduling (default: "
@@ -67,7 +77,9 @@ def main(argv=None):
     dispatcher = dataservice.DispatcherServer(
         heartbeat_interval=args.heartbeat, heartbeat_misses=args.misses,
         host=args.host, port=args.port, journal_dir=args.journal_dir,
-        snapshot_every=args.snapshot_every, affinity=args.affinity)
+        snapshot_every=args.snapshot_every, affinity=args.affinity,
+        journal_keep=args.journal_keep,
+        journal_keep_bytes=args.journal_keep_bytes)
     host, port = dispatcher.start()
     print("dispatcher ready on {}:{}".format(host, port), flush=True)
 

@@ -44,7 +44,10 @@ def main(argv=None):
                         help="read splits with ProcessPoolFeed")
     parser.add_argument("--cache-bytes", type=int, default=None,
                         help="chunk-cache byte budget (default: "
-                             "TFOS_DS_CACHE_BYTES env, 0/unset disables)")
+                             "TFOS_DS_CACHE_BYTES env, 0/unset disables); "
+                             "a starting value only — the driver autopilot "
+                             "can retune it live over the dispatcher "
+                             "heartbeat (dataservice_cache_budget knob)")
     parser.add_argument("--cache-spill-dir", default=None,
                         help="spill LRU-evicted cache entries to this dir")
     parser.add_argument("--no-cache-advertise", dest="advertise_cache",

@@ -191,7 +191,8 @@ class GatewayServer(object):
                 client.close()
             self._hb = reservation.HeartbeatSender(
                 addr, self.replica_id, self.heartbeat_interval,
-                metrics_provider=self.heartbeat_metrics).start()
+                metrics_provider=self.heartbeat_metrics,
+                on_reply=self._on_beat_reply).start()
         logger.info("gateway %s serving on %s:%d (max_batch=%d, "
                     "max_wait=%.1fms, max_queue=%d)", self.replica_id,
                     self.host, self.port, self.max_batch,
@@ -363,6 +364,41 @@ class GatewayServer(object):
             logger.debug("error callback failed (client gone?)",
                          exc_info=True)
 
+    # -- live knobs ---------------------------------------------------------
+
+    def _on_beat_reply(self, reply):
+        """Roster-beat reply hook: apply any live serving knob the driver
+        piggybacked (autopilot pushes via the reservation server's
+        KnobCoordinator — gateways beat there like any other node).  Both
+        targets are re-read fresh every ``_collect_batch`` iteration, so a
+        plain attribute store takes effect on the very next batch."""
+        knobs = reply.get("knobs") if isinstance(reply, dict) else None
+        if not knobs:
+            return
+        wait_ms = knobs.get("serving_max_wait_ms")
+        if wait_ms is not None:
+            try:
+                self.max_wait = max(float(wait_ms), 0.0) / 1000.0
+                logger.info("gateway %s: max_wait retuned to %.2fms",
+                            self.replica_id, self.max_wait * 1e3)
+            except (TypeError, ValueError):
+                logger.warning("gateway %s: bad serving_max_wait_ms %r",
+                               self.replica_id, wait_ms)
+        batch = knobs.get("serving_max_batch")
+        if batch is not None:
+            try:
+                # the compiled bucket ladder tops out at batch_size: a
+                # bigger batch would recompile on the hot path
+                self.max_batch = min(max(int(batch), 1),
+                                     self.server.batch_size)
+                logger.info("gateway %s: max_batch retuned to %d",
+                            self.replica_id, self.max_batch)
+            except (TypeError, ValueError):
+                logger.warning("gateway %s: bad serving_max_batch %r",
+                               self.replica_id, batch)
+        with self._cond:
+            self._cond.notify_all()  # a waiting batcher re-reads both
+
     # -- metrics ------------------------------------------------------------
 
     def heartbeat_metrics(self):
@@ -381,6 +417,10 @@ class GatewayServer(object):
                 "serving_compiles": self.server.compile_count,
                 "serving_queue_depth_hwm": depth_hwm,
                 "serving_batch_fill_pct_max": round(self._batch_fill_pct, 2),
+                # gauges: the CURRENT batching knobs, so the driver can
+                # confirm a live autopilot retune landed
+                "serving_max_wait_ms_max": round(self.max_wait * 1e3, 3),
+                "serving_max_batch_max": self.max_batch,
             }
         if lat:
             out["serving_p50_us_max"] = round(lat[len(lat) // 2], 1)

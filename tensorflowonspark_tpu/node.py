@@ -68,6 +68,52 @@ def _register_feed(feed):
     _feeds.append(weakref.ref(feed))
 
 
+# Live-knob application tallies, merged into the heartbeat counters so the
+# driver can see that its KNOB pushes actually landed on this node.
+_knob_counters = {"autopilot_knobs_applied": 0}
+
+
+def apply_knobs(knobs):
+    """Apply a ``{knob: value}`` dict from an autopilot KNOB push to every
+    live source in this process that understands it.
+
+    The registry is the same weakref list the heartbeat metrics walk: any
+    registered source exposing ``apply_knob(name, value) -> bool``
+    (ShardedFeed, ServiceFeed, DataFeed) gets a chance at each knob; names
+    nothing claims are ignored — a training node silently skips
+    ``serving_*`` knobs and vice versa.  Returns the number of (source,
+    knob) applications that took effect."""
+    applied = 0
+    for name, value in (knobs or {}).items():
+        for ref in list(_feeds):
+            feed = ref()
+            if feed is None:
+                continue
+            hook = getattr(feed, "apply_knob", None)
+            if hook is None:
+                continue
+            try:
+                if hook(name, value):
+                    applied += 1
+            except Exception:
+                logger.warning("apply_knob(%s) failed on %r", name, feed,
+                               exc_info=True)
+    if applied:
+        _knob_counters["autopilot_knobs_applied"] += applied
+        telemetry.get_tracer().instant("autopilot/knobs_applied",
+                                       applied=applied,
+                                       knobs=",".join(sorted(knobs)))
+    return applied
+
+
+def _knob_reply_handler(reply):
+    """``HeartbeatSender(on_reply=...)`` hook: apply any live-knob update
+    the driver piggybacked on the beat reply (exactly-once per push — the
+    KnobCoordinator marks pushes drained at poll time)."""
+    if isinstance(reply, dict) and reply.get("knobs"):
+        apply_knobs(reply["knobs"])
+
+
 def _profile_handler(job_name):
     """The ``on_profile`` capture handler for this node's HeartbeatSender:
     JAX-hosting jobs run device-trace captures fanned out on beat replies
@@ -105,6 +151,8 @@ def _node_metrics_provider(mgr, qname="input"):
         if not telemetry.get_tracer().enabled:
             return None
         parts = [shmring.counters_snapshot()]
+        if _knob_counters["autopilot_knobs_applied"]:
+            parts.append(dict(_knob_counters))
         try:
             # tracer self-telemetry: a nonzero events_dropped means this
             # process's trace files are silently truncated — surfaced as a
@@ -666,7 +714,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 heartbeat_interval,
                 metrics_provider=_node_metrics_provider(context.mgr),
                 trace_flow=node_meta.get("trace_flow"),
-                on_profile=_profile_handler(context.job_name)).start()
+                on_profile=_profile_handler(context.job_name),
+                on_reply=_knob_reply_handler).start()
             # Forked children inherit the parent's preemption registrations;
             # start from a clean slate, then install the SIGTERM drain in the
             # process that actually runs the user fn.
@@ -748,7 +797,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
                 heartbeat_interval,
                 metrics_provider=_node_metrics_provider(mgr),
                 trace_flow=node_meta.get("trace_flow"),
-                on_profile=_profile_handler(job_name)).start()
+                on_profile=_profile_handler(job_name),
+                on_reply=_knob_reply_handler).start()
             _reset_preemption()
             _install_sigterm_drain()
             telemetry.install_sigusr1()

@@ -21,7 +21,9 @@ dependency:
 - :class:`ObservatoryServer` — a stdlib ``ThreadingHTTPServer`` serving
   ``GET /metrics`` (Prometheus text), ``GET /status`` (JSON:
   ``tf_status`` + ``metrics_snapshot`` + ring depths), and — when a
-  watchtower is attached — ``GET /alerts`` (the bounded alert log),
+  watchtower is attached — ``GET /alerts`` (the bounded alert log) — and,
+  when an autopilot is attached, ``GET /autopilot`` (knob values, pending
+  action, bounded action log),
   started by ``cluster.run(..., observatory=True)`` next to the
   rendezvous and stopped with it.  Every render works from ONE snapshot
   copy taken at scrape start, so a node dying mid-scrape can never
@@ -297,7 +299,8 @@ def _render_histogram(fams, executor, counters):
 
 
 def render_prometheus(snapshot, ring=None, window_secs=60.0,
-                      scrapes=None, alert_counts=None, info=None):
+                      scrapes=None, alert_counts=None, info=None,
+                      autopilot_counts=None, autopilot_ticks=None):
     """Prometheus text exposition (0.0.4) from one metrics snapshot.
 
     ``snapshot`` is the ``{"nodes": {id: counters}, "aggregate": {...}}``
@@ -306,7 +309,10 @@ def render_prometheus(snapshot, ring=None, window_secs=60.0,
     nodes die underneath the scrape.  ``ring`` (a :class:`SampleRing`)
     contributes windowed rate gauges; ``alert_counts`` (``{rule: n}``,
     typically ``Watchtower.alert_counts``) the ``tfos_alerts_total``
-    family; ``info`` (:func:`build_info`) the ``tfos_build_info`` gauge.
+    family; ``autopilot_counts`` (``{stage: n}``, typically
+    ``Autopilot.action_counts``) the ``tfos_autopilot_actions_total``
+    family plus ``tfos_autopilot_ticks_total``; ``info``
+    (:func:`build_info`) the ``tfos_build_info`` gauge.
     """
     nodes = (snapshot or {}).get("nodes") or {}
     fams = _Families()
@@ -333,6 +339,18 @@ def render_prometheus(snapshot, ring=None, window_secs=60.0,
                      'tfos_alerts_total{rule="%s"} %s'
                      % (_escape_label(rule),
                         _fmt_value(alert_counts[rule])))
+    if autopilot_counts:
+        for stage in sorted(autopilot_counts):
+            fams.add("tfos_autopilot_actions_total", "counter",
+                     "Autopilot control actions, by lifecycle stage "
+                     "(proposed/applied/effect/kept/reverted).",
+                     'tfos_autopilot_actions_total{stage="%s"} %s'
+                     % (_escape_label(stage),
+                        _fmt_value(autopilot_counts[stage])))
+    if autopilot_ticks is not None:
+        fams.add("tfos_autopilot_ticks_total", "counter",
+                 "Autopilot controller ticks executed.",
+                 "tfos_autopilot_ticks_total %d" % autopilot_ticks)
 
     for executor in sorted(nodes):
         counters = nodes[executor]
@@ -388,7 +406,7 @@ class ObservatoryServer(object):
     def __init__(self, snapshot_fn, ring=None, status_fn=None,
                  host="0.0.0.0", port=0, window_secs=60.0,
                  profile_fn=None, profiler_addresses_fn=None,
-                 capture_status_fn=None, watchtower=None):
+                 capture_status_fn=None, watchtower=None, autopilot=None):
         """``profile_fn(duration_ms=, steps=)`` backs ``GET /profile``
         (typically ``CaptureCoordinator.trigger``; 503 when absent).
         ``profiler_addresses_fn`` / ``capture_status_fn`` enrich ``/status``
@@ -396,13 +414,16 @@ class ObservatoryServer(object):
         state — lazy callables, because the observatory starts before the
         roster exists.  ``watchtower`` (a ``watchtower.Watchtower``) backs
         ``GET /alerts``, the ``/status`` watchtower block, and the
-        ``tfos_alerts_total`` counters on ``/metrics``."""
+        ``tfos_alerts_total`` counters on ``/metrics``.  ``autopilot`` (an
+        ``autopilot.Autopilot``) backs ``GET /autopilot``, the ``/status``
+        autopilot block, and the ``tfos_autopilot_*`` counters."""
         self._snapshot_fn = snapshot_fn
         self._status_fn = status_fn
         self._profile_fn = profile_fn
         self._profiler_addresses_fn = profiler_addresses_fn
         self._capture_status_fn = capture_status_fn
         self.watchtower = watchtower
+        self.autopilot = autopilot
         self._build_info = None
         self.ring = ring if ring is not None else SampleRing()
         self._window_secs = window_secs
@@ -433,11 +454,23 @@ class ObservatoryServer(object):
                 alert_counts = self.watchtower.alert_counts()
             except Exception:
                 alert_counts = None
+        autopilot_counts = None
+        autopilot_ticks = None
+        if self.autopilot is not None:
+            try:
+                pilot_status = self.autopilot.status()
+                autopilot_counts = pilot_status.get("action_counts")
+                autopilot_ticks = pilot_status.get("ticks")
+            except Exception:
+                autopilot_counts = None
+                autopilot_ticks = None
         return render_prometheus(snapshot, ring=self.ring,
                                  window_secs=self._window_secs,
                                  scrapes=self._scrapes,
                                  alert_counts=alert_counts,
-                                 info=self._build_info)
+                                 info=self._build_info,
+                                 autopilot_counts=autopilot_counts,
+                                 autopilot_ticks=autopilot_ticks)
 
     def _alerts_json(self, query):
         if self.watchtower is None:
@@ -460,6 +493,26 @@ class ObservatoryServer(object):
             }
         except Exception as e:
             logger.exception("observatory: /alerts failed")
+            return 500, json.dumps({"error": repr(e)})
+        return 200, json.dumps(payload, default=str)
+
+    def _autopilot_json(self, query):
+        if self.autopilot is None:
+            return 503, json.dumps(
+                {"error": "autopilot is not enabled on this cluster"})
+        import urllib.parse
+
+        params = urllib.parse.parse_qs(query or "")
+        try:
+            limit = int(params["limit"][0]) if params.get("limit") else None
+        except ValueError:
+            return 400, json.dumps({"error": "limit must be an integer"})
+        try:
+            payload = dict(self.autopilot.status(), time=time.time())
+            if limit is not None:
+                payload["actions"] = self.autopilot.actions(limit=limit)
+        except Exception as e:
+            logger.exception("observatory: /autopilot failed")
             return 500, json.dumps({"error": repr(e)})
         return 200, json.dumps(payload, default=str)
 
@@ -501,6 +554,11 @@ class ObservatoryServer(object):
                 payload["watchtower"] = self.watchtower.status()
             except Exception:
                 payload["watchtower"] = None
+        if self.autopilot is not None:
+            try:
+                payload["autopilot"] = self.autopilot.status()
+            except Exception:
+                payload["autopilot"] = None
         # tf_status may hold arbitrary user values; never let one break
         # the endpoint
         return json.dumps(payload, default=str)
@@ -563,9 +621,13 @@ class ObservatoryServer(object):
                     code, text = observatory._alerts_json(query)
                     body = text.encode("utf-8")
                     ctype = "application/json"
+                elif path in ("/autopilot", "/autopilot/"):
+                    code, text = observatory._autopilot_json(query)
+                    body = text.encode("utf-8")
+                    ctype = "application/json"
                 elif path == "/":
                     body = (b"tfos observatory: /metrics /status "
-                            b"/profile /alerts\n")
+                            b"/profile /alerts /autopilot\n")
                     ctype = "text/plain; charset=utf-8"
                 else:
                     self.send_error(404)

@@ -134,6 +134,7 @@ class ShardedFeed(object):
         self._num_processes = jax.process_count()
         self._stop = None            # prefetch stop event (set in batches())
         self._prefetch_thread = None
+        self._prefetch_buf = None    # live prefetch queue (apply_knob target)
         # Trace-flow relay: ids popped from the upstream feed
         # (ServiceFeed.pop_flow_id) at device-put time, re-parked here for
         # the trainer's dispatch leg (pop_dispatch_flow).  Best-effort,
@@ -215,7 +216,31 @@ class ShardedFeed(object):
             "infeed_assembly_us_hwm": self._assembly_us_hwm,
             "infeed_put_us": self._put_us,
             "infeed_put_us_hwm": self._put_us_hwm,
+            # gauge (never summed): the CURRENT depth, so the driver can
+            # confirm a live autopilot retune landed
+            "infeed_prefetch_depth_max": self._prefetch_depth,
         }
+
+    def apply_knob(self, name, value):
+        """Live-knob hook (autopilot KNOB pushes; see docs/AUTOPILOT.md).
+
+        ``infeed_prefetch`` retunes the prefetch depth mid-run: the new
+        bound is applied to the RUNNING prefetch queue in place (under its
+        mutex, waking blocked putters — a raise takes effect on the very
+        next produced batch).  A feed built with ``prefetch=0`` has no
+        producer thread to rebound, so a raise there takes effect at the
+        next ``batches()`` call.  Returns True when the knob was claimed.
+        """
+        if name != "infeed_prefetch":
+            return False
+        depth = max(int(value), 1)
+        self._prefetch_depth = depth
+        buf = self._prefetch_buf
+        if buf is not None:
+            with buf.mutex:
+                buf.maxsize = depth
+                buf.not_full.notify_all()
+        return True
 
     def _next_local(self):
         """Assemble this host's local batch as final columnar arrays;
@@ -562,6 +587,7 @@ class ShardedFeed(object):
         batches of HBM).  ``stop`` aborts the producer when the consumer
         exits early (max_steps / consensus)."""
         buf = _queue.Queue(maxsize=self._prefetch_depth)
+        self._prefetch_buf = buf
 
         def _put(item):
             while not stop.is_set():

@@ -277,6 +277,12 @@ class Server(MessageSocket):
         # messages (``receive(data)``).  Attached by cluster.run when the
         # observatory is enabled; None keeps the HBEAT path byte-identical.
         self.profile_coordinator = None
+        # Optional live-knob coordinator (KnobCoordinator): pending knob
+        # updates from the autopilot ride OUT on HBEAT replies
+        # (``poll(executor_id)``), each node seeing each push exactly once.
+        # Attached by cluster.run when the autopilot is enabled; None keeps
+        # the HBEAT path byte-identical.
+        self.knob_coordinator = None
         # Executors whose HBEAT-carried trace flow was already stitched into
         # the driver trace (one flow step per node, not one per beat).
         self._hbeat_flow_seen = set()
@@ -566,6 +572,17 @@ class Server(MessageSocket):
                         req = None
                     if req:
                         reply["profile"] = req
+                # Knob fan-out: pending live-knob updates for this executor
+                # ride the same beat reply (poll marks them delivered, so
+                # each node applies each push exactly once).
+                if self.knob_coordinator is not None:
+                    try:
+                        knobs = self.knob_coordinator.poll(executor_id)
+                    except Exception:
+                        logger.exception("knob coordinator poll failed")
+                        knobs = None
+                    if knobs:
+                        reply["knobs"] = knobs
                 self.send(sock, reply)
             else:
                 self.send(sock, {"type": "ERR",
@@ -864,6 +881,65 @@ class Client(MessageSocket):
             self._sock.close()
         except OSError:
             pass
+
+
+class KnobCoordinator(object):
+    """Pending live-knob updates, fanned out exactly-once per node on
+    heartbeat replies (the ``PROF``/``reregister`` pattern).
+
+    The autopilot calls :meth:`push` with a ``{knob: value}`` dict; the
+    reservation server's HBEAT handler calls :meth:`poll` per beat and
+    attaches the merged unseen pushes as ``reply["knobs"]``.  Each push
+    carries a sequence number and each executor tracks the last sequence
+    it drained, so a node sees every push exactly once regardless of when
+    it registered — late joiners (and elastic replacements, which beat
+    under a fresh identity) drain the full history and converge to the
+    controller's current intent.  Thread-safe; values are opaque here.
+    """
+
+    def __init__(self, history=256):
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._pushes = []  # [(seq, {knob: value})], bounded
+        self._seen = {}    # executor_id -> last drained seq
+        self._history = int(history)
+
+    def push(self, knobs, executor_id=None):
+        """Queue ``knobs`` for every node (or one ``executor_id``).
+        Returns the push's sequence number."""
+        if not knobs:
+            return self._seq
+        with self._lock:
+            self._seq += 1
+            self._pushes.append((self._seq, dict(knobs), executor_id))
+            del self._pushes[:-self._history]
+            return self._seq
+
+    def poll(self, executor_id):
+        """Merged ``{knob: value}`` of every push this executor has not
+        seen (newest wins per knob), or ``None``.  Marks them drained."""
+        ex = str(executor_id)
+        with self._lock:
+            last = self._seen.get(ex, 0)
+            merged = {}
+            for seq, knobs, target in self._pushes:
+                if seq <= last:
+                    continue
+                if target is not None and str(target) != ex:
+                    continue
+                merged.update(knobs)
+            self._seen[ex] = self._seq
+            return merged or None
+
+    def current(self):
+        """Newest-wins merge of every broadcast push (the controller's
+        standing intent) — the ``/autopilot`` debugging view."""
+        with self._lock:
+            merged = {}
+            for _seq, knobs, target in self._pushes:
+                if target is None:
+                    merged.update(knobs)
+            return merged
 
 
 class HeartbeatSender(object):

@@ -591,7 +591,14 @@ class Watchtower(object):
       config: key-wise overrides of :data:`DEFAULT_CONFIG`.
       journal_path: append-only JSONL journal file (parent dirs created);
         ``None`` disables journaling.
-      on_alert: optional ``fn(alert_dict)`` per admitted alert.
+      on_alert: optional ``fn(alert_dict)`` per admitted alert.  This is
+        the watchtower→autopilot bridge: ``cluster.run(autopilot=...)``
+        wires ``Autopilot.observe_alert`` here, turning performance alerts
+        (``infeed_starved``, ``dataservice_saturation``, ``cache_thrash``,
+        ``latency_slo_burn``) into timestamped retune hints the controller
+        may act on when its own window sensors are silent (see
+        ``autopilot.ALERT_HINTS``).  The callback runs on the watchtower
+        tick thread — keep it cheap.
       on_suspect: optional ``fn(executor_id, alert_dict)`` fired for
         :data:`SUSPECT_RULES` verdicts — the hook the elastic-recovery
         plane consumes (see docs/FAULT_TOLERANCE.md).

@@ -1151,6 +1151,96 @@ def test_journal_recovery_restores_ledger(tmp_path):
         disp2.stop()
 
 
+def _fabricate_generations(disp, seqs, journal_bytes=100):
+    """Write a snapshot + journal segment pair for each generation."""
+    os.makedirs(disp.journal_dir, exist_ok=True)
+    for s in seqs:
+        with open(disp._segment_path("snapshot", s), "w") as f:
+            f.write("{}")
+        with open(disp._segment_path("journal", s), "w") as f:
+            f.write("x" * journal_bytes)
+
+
+def _kept_generations(disp, seqs):
+    return sorted(s for s in seqs
+                  if os.path.exists(disp._segment_path("snapshot", s)))
+
+
+def test_journal_compaction_keeps_newest_count(tmp_path):
+    """journal_keep=N: compaction after cutting generation 6 unlinks every
+    snapshot/journal pair older than the newest N generations."""
+    disp = DispatcherServer(heartbeat_interval=0,
+                            journal_dir=str(tmp_path / "j"), journal_keep=3)
+    _fabricate_generations(disp, range(1, 7))
+    disp._prune_segments(6)
+    assert _kept_generations(disp, range(1, 7)) == [4, 5, 6]
+    for kind in ("snapshot", "journal"):
+        assert not os.path.exists(disp._segment_path(kind, 1))
+
+
+def test_journal_compaction_byte_budget(tmp_path):
+    """journal_keep_bytes: keep the newest generations that fit the
+    budget — and the newest generation survives even when it alone
+    overflows the budget."""
+    disp = DispatcherServer(heartbeat_interval=0,
+                            journal_dir=str(tmp_path / "j"),
+                            journal_keep_bytes=250)
+    # each generation is 102 bytes (2-byte snapshot + 100-byte journal):
+    # 6 fits, 6+5 = 204 fits, 6+5+4 = 306 overflows
+    _fabricate_generations(disp, range(1, 7))
+    disp._prune_segments(6)
+    assert _kept_generations(disp, range(1, 7)) == [5, 6]
+
+    tight = DispatcherServer(heartbeat_interval=0,
+                             journal_dir=str(tmp_path / "tight"),
+                             journal_keep_bytes=50)
+    _fabricate_generations(tight, range(1, 4))
+    tight._prune_segments(3)
+    assert _kept_generations(tight, range(1, 4)) == [3]
+
+
+def test_journal_compaction_live_snapshot_cycle(tmp_path):
+    """End-to-end over the real snapshot path: with snapshot_every=2 and
+    journal_keep=2 a long mutation stream leaves exactly the two newest
+    generations on disk, and recovery from the compacted tail still
+    restores the ledger."""
+    jdir = str(tmp_path / "journal")
+    disp = DispatcherServer(heartbeat_interval=0, host="127.0.0.1",
+                            journal_dir=jdir, snapshot_every=2,
+                            journal_keep=2)
+    addr = disp.start()
+    client = DispatcherClient(addr)
+    client.register_worker("w", "127.0.0.1", 1)
+    splits = ["s{}".format(i) for i in range(8)]
+    client.register_job("j", splits, consumer_id="c0")
+    for i in range(8):
+        assert client.request_task("j", "w", "c0")["splits"] == \
+            [[i, splits[i]]]
+        client.done_split("j", 0, i, "c0")
+    client.close()
+
+    def _ledger(status):
+        # affinity_* are scheduling stats, not ledger state: not journaled
+        return {k: v for k, v in status.items()
+                if not k.startswith("affinity_")}
+
+    live_status = _ledger(disp.job_status("j"))
+    seq = disp._journal_seq
+    assert seq >= 3            # enough generations cut to force pruning
+    kept = _kept_generations(disp, range(1, seq + 1))
+    assert kept == [seq - 1, seq]
+    disp._stopping = True      # SIGKILL analogue, recover off the tail
+    disp._socket.close()
+    disp2 = DispatcherServer(heartbeat_interval=0, host="127.0.0.1",
+                             journal_dir=jdir)
+    disp2.start()
+    try:
+        assert disp2.recovered_jobs == 1
+        assert _ledger(disp2.job_status("j")) == live_status
+    finally:
+        disp2.stop()
+
+
 @pytest.mark.chaos(timeout=90)
 def test_dispatcher_crash_restart_mid_job_exactly_once(tmp_path):
     """The journal tentpole e2e: the dispatcher is crashed mid-job (socket

@@ -65,7 +65,12 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # a 2-node cluster with prefetch pinned low gets its depth raised by the
 # controller mid-run, the measured starvation wall-fraction drops, every
 # action lands in the journal and on /autopilot, and metrics_replay.py
-# re-derives the action stream offline
+# re-derives the action stream offline, and prove the control plane
+# itself survives: the primary reservation server is stalled then
+# SIGKILLed mid-run, the warm standby promotes off the journal under a
+# bumped fencing epoch, the zombie's writes are rejected by epoch, nodes
+# re-home via endpoint-list redial with exact item totals and no healthy
+# node false-fenced during the takeover grace window
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -79,5 +84,6 @@ python scripts/ci_assert_serving.py
 python scripts/ci_assert_warmstart.py
 python scripts/ci_assert_shared.py
 python scripts/ci_assert_autopilot.py
+python scripts/ci_assert_ha.py
 
 exit $rc

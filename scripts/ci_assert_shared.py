@@ -193,7 +193,16 @@ def main():
                         "tfos_dataservice_affinity_total_total{",
                         "tfos_dataservice_affinity_hit_pct_max{"):
                 if line.startswith(key):
-                    scraped[key.rstrip("{")] = float(line.rsplit(None, 1)[1])
+                    # one sample PER EXECUTOR: counters sum across the
+                    # fleet, gauges take the max — a plain overwrite would
+                    # let whichever consumer scored zero (warm hits land
+                    # on ONE of them) clobber the other's tally
+                    name = key.rstrip("{")
+                    value = float(line.rsplit(None, 1)[1])
+                    if name.endswith("_max"):
+                        scraped[name] = max(scraped.get(name, 0.0), value)
+                    else:
+                        scraped[name] = scraped.get(name, 0.0) + value
         assert scraped.get("tfos_dataservice_cache_hit_total", 0) > 0, \
             "no tfos_dataservice_cache_hit_total on /metrics"
         assert scraped.get("tfos_dataservice_affinity_total_total", 0) > 0, \

@@ -188,7 +188,14 @@ class Autopilot(object):
     """
 
     def __init__(self, ring, actuator=None, snapshot_fn=None, config=None,
-                 journal_path=None, on_action=None, clock=time.time):
+                 journal_path=None, on_action=None, clock=time.time,
+                 resume_values=None):
+        """``resume_values``: optional ``{knob: value}`` overriding each
+        knob's configured ``initial`` — a coordinator recovered from its
+        journal hands the fleet's standing knob state here
+        (``KnobCoordinator.current()``), so a controller restarted after a
+        failover resumes from where the fleet actually IS instead of
+        re-walking every retune from the configured defaults."""
         self.config = merge_config(config)
         self.ring = ring
         self.actuator = actuator
@@ -203,6 +210,9 @@ class Autopilot(object):
         # driver-side shadow of each knob's current value
         self._values = {name: spec.get("initial")
                         for name, spec in self.config["knobs"].items()}
+        for name, value in (resume_values or {}).items():
+            if name in self._values and value is not None:
+                self._values[name] = value
         self._cooldown_until = {}
         self._streak = {}          # knob -> consecutive firing ticks
         self._hints = {}           # knob -> (direction, alert_time, rule)

@@ -801,7 +801,12 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
             # applies the namespaced knobs its registered feeds claim
             # (node.apply_knobs); unclaimed names are ignored, so one
             # broadcast serves trainers, gateways, and worker relays alike.
-            server.knob_coordinator = reservation.KnobCoordinator()
+            # A journal-armed server may already have rebuilt the
+            # coordinator (full push history + drain positions) during
+            # recovery — reuse it so the fleet's standing knob state
+            # survives the coordinator death.
+            if server.knob_coordinator is None:
+                server.knob_coordinator = reservation.KnobCoordinator()
             ap_config = dict(autopilot) if isinstance(autopilot, dict) else {}
             ap_knobs = {k: dict(v)
                         for k, v in (ap_config.get("knobs") or {}).items()}
@@ -815,12 +820,17 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                 except ValueError:
                     ap_knobs["infeed_prefetch"]["initial"] = 2
             ap_config["knobs"] = ap_knobs
+            # push_knobs (not the bare KnobCoordinator.push) journals each
+            # retune when the server is journal-armed, so the controller's
+            # standing intent rides a coordinator failover; resume_values
+            # re-seeds the controller from the recovered push history.
             pilot = autopilot_mod.Autopilot(
-                ring, actuator=server.knob_coordinator.push,
+                ring, actuator=server.push_knobs,
                 snapshot_fn=server.metrics_snapshot,
                 config=ap_config,
                 journal_path=os.path.abspath(os.path.join(
-                    log_dir or ".", "autopilot", "journal.jsonl")))
+                    log_dir or ".", "autopilot", "journal.jsonl")),
+                resume_values=server.knob_coordinator.current())
             pilot.start()
             logger.info("autopilot engaged (dry_run=%s), journal at %s",
                         pilot.config["dry_run"], pilot.journal_path)
@@ -848,6 +858,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                 journal_path=os.path.abspath(os.path.join(
                     log_dir or ".", "watchtower", "journal.jsonl")),
                 on_suspect=_on_suspect, beat_ages_fn=server.beat_ages,
+                coordinator_fn=server.ha_status,
                 on_alert=(pilot.observe_alert if pilot is not None
                           else None))
             wt.start()
@@ -863,7 +874,8 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
             profile_fn=profiling_coord.trigger,
             profiler_addresses_fn=_profiler_addresses,
             capture_status_fn=profiling_coord.status,
-            watchtower=wt, autopilot=pilot)
+            watchtower=wt, autopilot=pilot,
+            coordinator_fn=server.ha_status)
         addr = obs.start()
         logger.info("observatory serving /metrics, /status, /profile and "
                     "/alerts at http://%s:%d", addr[0], addr[1])
@@ -877,12 +889,30 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                   if isinstance(data_service, dict) else None)
         addr = (data_service.get("dispatcher")
                 if isinstance(data_service, dict) else data_service)
-        if isinstance(addr, str):
-            host, _, port = addr.rpartition(":")
-            addr = (host, int(port))
-        data_service = {"dispatcher": [addr[0], int(addr[1])]}
+        # "dispatcher" may be one endpoint or a LIST (primary first, warm
+        # standbys at pinned ports after): a single endpoint keeps the
+        # historic [host, port] JSON shape, a list becomes [[host, port],
+        # ...] — ServiceFeed/FeedWorker normalize either and redial across
+        # the list on a dispatcher failover.
+        eps = reservation.normalize_endpoints(addr)
+        if len(eps) == 1:
+            data_service = {"dispatcher": [eps[0][0], int(eps[0][1])]}
+        else:
+            data_service = {"dispatcher": [[h, int(p)] for h, p in eps]}
         if codecs is not None:
             data_service["codecs"] = list(codecs)
+
+    # Reservation-coordinator endpoint list for the nodes: the live
+    # primary first, then any warm standbys at pre-agreed pinned ports
+    # (TFOS_RS_STANDBY env: "host:port[,host:port...]").  Node-side
+    # Client/HeartbeatSender redial across the list, so a coordinator
+    # failover needs no re-broadcast of cluster_meta.
+    server_addrs = [list(server_addr)]
+    for part in (os.environ.get("TFOS_RS_STANDBY") or "").split(","):
+        part = part.strip()
+        if part:
+            shost, _, sport = part.rpartition(":")
+            server_addrs.append([shost, int(sport)])
 
     cluster_meta = {
         "id": "{:x}".format(random.getrandbits(64)),
@@ -890,6 +920,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         "num_executors": num_executors,
         "default_fs": getattr(cluster_backend, "default_fs", "file://"),
         "server_addr": list(server_addr),
+        "server_addrs": server_addrs,
         "authkey": uuid.uuid4().bytes.hex(),
         "reservation_timeout": reservation_timeout,
         "input_mode": input_mode,

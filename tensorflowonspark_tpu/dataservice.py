@@ -95,8 +95,10 @@ import time
 import numpy as np
 
 from tensorflowonspark_tpu import fault, marker, telemetry, transport, wire
+from tensorflowonspark_tpu import standby as standby_mod
 from tensorflowonspark_tpu.reservation import (
-    Client, HeartbeatSender, KnobCoordinator, MessageSocket)
+    Client, HeartbeatSender, KnobCoordinator, MessageSocket,
+    normalize_endpoints)
 
 logger = logging.getLogger(__name__)
 
@@ -541,11 +543,24 @@ class DispatcherServer(MessageSocket):
 
     def __init__(self, heartbeat_interval=1.0, heartbeat_misses=3,
                  host=None, port=0, journal_dir=None, snapshot_every=None,
-                 affinity=None, journal_keep=None, journal_keep_bytes=None):
+                 affinity=None, journal_keep=None, journal_keep_bytes=None,
+                 beacon_interval=None, takeover_grace=None):
         self.heartbeat_interval = heartbeat_interval
         self.heartbeat_misses = heartbeat_misses
         self._host = host
         self._port = int(port)
+        if beacon_interval is None:
+            beacon_interval = (min(max(heartbeat_interval / 2.0, 0.1), 0.5)
+                               if heartbeat_interval else 0.5)
+        self.beacon_interval = float(beacon_interval)
+        self._takeover_grace = takeover_grace
+        # Fencing epoch: 0 until this incarnation claims a journal dir; see
+        # reservation.Server — same protocol, same standby module.
+        self.fencing_epoch = 0
+        self.superseded_by = None
+        self.journal_records = 0
+        self._beacon_last = 0.0
+        self._fence_grace_until = 0.0
         if journal_dir is None:
             journal_dir = os.environ.get("TFOS_DS_JOURNAL_DIR") or None
         self.journal_dir = journal_dir
@@ -618,6 +633,67 @@ class DispatcherServer(MessageSocket):
             job = self._jobs.get(name)
             return job.status() if job is not None else None
 
+    # -- fencing epoch + reply stamping (see reservation.Server) -----------
+
+    def send(self, sock, msg):
+        # Stamped under "fence_epoch", NOT "epoch": TASK replies already
+        # carry the job's DATA epoch as "epoch", and a client reading a
+        # fresh job's epoch 0 as a fencing epoch would refuse a healthy
+        # dispatcher (DispatcherClient._fence_epoch_key matches this key).
+        if self.fencing_epoch and isinstance(msg, dict):
+            msg.setdefault("fence_epoch", self.fencing_epoch)
+        MessageSocket.send(self, sock, msg)
+
+    def _check_epoch(self):
+        """Ledger-ownership check: a newer fencing epoch on disk means a
+        successor (restart or promoted standby) claimed the journal — this
+        incarnation fences itself and answers everything ERR."""
+        if not self.journal_dir or self.superseded_by is not None:
+            return
+        on_disk = standby_mod.read_epoch(self.journal_dir)
+        if on_disk > self.fencing_epoch:
+            self.superseded_by = on_disk
+            logger.error(
+                "dispatcher fenced: epoch %d on disk supersedes this "
+                "incarnation's epoch %d — a successor owns the ledger",
+                on_disk, self.fencing_epoch)
+            telemetry.get_tracer().instant(
+                "dataservice/zombie_fenced", epoch=self.fencing_epoch,
+                superseded_by=on_disk)
+            if self._journal_file is not None:
+                try:
+                    self._journal_file.close()
+                except OSError:
+                    pass
+                self._journal_file = None
+
+    def _stamp_beacon(self, addr, force=False):
+        if not self.journal_dir or self.superseded_by is not None:
+            return
+        now = time.monotonic()
+        if not force and now - self._beacon_last < self.beacon_interval:
+            return
+        self._beacon_last = now
+        self._check_epoch()
+        if self.superseded_by is None:
+            standby_mod.write_beacon(self.journal_dir, self.fencing_epoch,
+                                     host=addr[0], port=addr[1],
+                                     role="dispatcher")
+
+    def ha_status(self):
+        """Coordinator-HA block for ``/status`` + ``tfos_coordinator_*``."""
+        return {
+            "journal_dir": self.journal_dir,
+            "epoch": self.fencing_epoch,
+            "superseded_by": self.superseded_by,
+            "recovered_nodes": self.recovered_jobs,
+            "recoveries": 1 if self.recovered_jobs else 0,
+            "journal_records": self.journal_records,
+            "snapshot_seq": self._journal_seq,
+            "grace_remaining_secs": round(
+                max(0.0, self._fence_grace_until - time.monotonic()), 3),
+        }
+
     # -- journal (caller holds the lock) -----------------------------------
 
     def _segment_path(self, kind, seq):
@@ -632,6 +708,9 @@ class DispatcherServer(MessageSocket):
         operation with a loud log — availability over durability."""
         if self._journal_file is None:
             return
+        self._check_epoch()  # never append past a successor's claim
+        if self._journal_file is None:
+            return
         try:
             self._journal_file.write(json.dumps(rec, sort_keys=True) + "\n")
             self._journal_file.flush()
@@ -644,6 +723,7 @@ class DispatcherServer(MessageSocket):
                 pass
             self._journal_file = None
             return
+        self.journal_records += 1
         self._journal_count += 1
         if self._journal_count >= self.snapshot_every:
             self._write_snapshot()
@@ -807,6 +887,16 @@ class DispatcherServer(MessageSocket):
             for c in job.consumers:
                 self._consumer_seen[(job.name, c)] = now
         self.recovered_jobs = len(self._jobs)
+        if self.recovered_jobs:
+            # Fence-free grace while recovered workers and consumers
+            # re-home to this incarnation; a fresh (journal-less history)
+            # dispatcher sets none, so first starts behave exactly as
+            # before.
+            grace = self._takeover_grace
+            if grace is None:
+                grace = max(
+                    self.heartbeat_interval * self.heartbeat_misses, 2.0)
+            self._fence_grace_until = now + grace
         if self._jobs or replayed or seq:
             logger.warning(
                 "dataservice dispatcher: recovered %d job(s) from %s "
@@ -875,8 +965,13 @@ class DispatcherServer(MessageSocket):
     def _check_liveness(self):
         if not self.heartbeat_interval:
             return
-        deadline = self.heartbeat_interval * self.heartbeat_misses
         now = time.monotonic()
+        if now < self._fence_grace_until:
+            # Post-takeover grace: recovered workers/consumers were beating
+            # at the dead predecessor; their silence is our history, not a
+            # death — let them re-home before fencing anyone.
+            return
+        deadline = self.heartbeat_interval * self.heartbeat_misses
         with self._lock:
             for worker_id, last in list(self._beats.items()):
                 age = now - last
@@ -1009,6 +1104,21 @@ class DispatcherServer(MessageSocket):
         mtype = msg.get("type")
         data = msg.get("data") or {}
         with self._lock:
+            if mtype in ("WREG", "HBEAT", "BYE", "JOB", "DETACH", "TASK",
+                         "DONE", "LOST", "KNOB"):
+                # Mutating request: re-verify ledger ownership FIRST so a
+                # zombie dispatcher never mutates state its successor
+                # doesn't have (and never replies OK for it).
+                self._check_epoch()
+            if self.superseded_by is not None:
+                self.send(sock, {
+                    "type": "ERR", "fence_epoch": self.superseded_by,
+                    "superseded": self.superseded_by,
+                    "error": "dispatcher superseded: epoch {} claimed the "
+                             "ledger (this incarnation was epoch {}); "
+                             "redial the promoted dispatcher".format(
+                                 self.superseded_by, self.fencing_epoch)})
+                return True
             if mtype == "WREG":
                 err = self._register_worker(data)
                 if err:
@@ -1278,6 +1388,11 @@ class DispatcherServer(MessageSocket):
         self._socket.listen(64)
         if self.journal_dir:
             with self._lock:
+                # Claim the ledger BEFORE recovering: the epoch bump fences
+                # any prior incarnation (restart-in-place or the primary a
+                # standby is superseding) out of the journal.
+                self.fencing_epoch = standby_mod.advance_epoch(
+                    self.journal_dir)
                 self._recover()
         host = self._host
         if not host:
@@ -1285,6 +1400,8 @@ class DispatcherServer(MessageSocket):
 
             host = util.get_ip_address()
         addr = (host, self._socket.getsockname()[1])
+        if self.journal_dir:
+            self._stamp_beacon(addr, force=True)
 
         def _listen():
             conns = [self._socket]
@@ -1309,6 +1426,7 @@ class DispatcherServer(MessageSocket):
                         conns.remove(sock)
                         sock.close()
                 self._check_liveness()
+                self._stamp_beacon(addr)
             for sock in conns:
                 try:
                     sock.close()
@@ -1326,6 +1444,14 @@ class DispatcherServer(MessageSocket):
     def stop(self):
         self._stopping = True
         if self._socket is not None:
+            # shutdown() before close(): the listener's select() holds a
+            # kernel reference to the listen socket, and a bare close()
+            # leaves the port accepting-then-resetting for up to one poll
+            # timeout — a failing-over client would waste a dial on it.
+            try:
+                self._socket.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._socket.close()
             except OSError:
@@ -1348,6 +1474,10 @@ class DispatcherServer(MessageSocket):
 class DispatcherClient(Client):
     """Typed request helpers over the rendezvous ``Client`` transport
     (connect retries, finite request timeouts, ``HBEAT``/``BYE`` reuse)."""
+
+    # The dispatcher protocol uses "epoch" for the job DATA epoch, so its
+    # fencing epoch rides a separate key (see DispatcherServer.send).
+    _fence_epoch_key = "fence_epoch"
 
     def _call(self, mtype, data=None):
         resp = self._request({"type": mtype, "data": data or {}})
@@ -1749,7 +1879,11 @@ class FeedWorker(object):
                  use_process_pool=False, num_procs=2, retry_policy=None,
                  cache_bytes=None, cache_spill_dir=None,
                  advertise_cache=None):
-        self.dispatcher_addr = _addr_tuple(dispatcher_addr)
+        # Endpoint-list discovery: entry 0 the primary dispatcher, later
+        # entries warm standbys at pinned ports; DispatcherClient redials
+        # across the list, so a worker survives a dispatcher failover.
+        self.dispatcher_endpoints = normalize_endpoints(dispatcher_addr)
+        self.dispatcher_addr = self.dispatcher_endpoints[0]
         self.row_reader = row_reader
         self.host = host
         self.port = port
@@ -1799,7 +1933,7 @@ class FeedWorker(object):
         self.port = self._socket.getsockname()[1]
 
         def _register():
-            client = DispatcherClient(self.dispatcher_addr)
+            client = DispatcherClient(self.dispatcher_endpoints)
             try:
                 client.register_worker(
                     self.worker_id, self.host, self.port,
@@ -1810,7 +1944,8 @@ class FeedWorker(object):
 
         self.retry_policy.call(_register)
         self._heartbeat = HeartbeatSender(
-            self.dispatcher_addr, self.worker_id, self.heartbeat_interval,
+            self.dispatcher_endpoints, self.worker_id,
+            self.heartbeat_interval,
             metrics_provider=self._heartbeat_metrics,
             on_reply=self._on_beat_reply).start()
         self._accept_thread = threading.Thread(
@@ -1871,7 +2006,7 @@ class FeedWorker(object):
             return
         self._last_rereg = now
         try:
-            client = DispatcherClient(self.dispatcher_addr, retries=0)
+            client = DispatcherClient(self.dispatcher_endpoints, retries=0)
             try:
                 client.register_worker(
                     self.worker_id, self.host, self.port,
@@ -1925,7 +2060,7 @@ class FeedWorker(object):
             # wise, pay-off sampled).  A hello without "codecs" — an older
             # consumer — gets raw frames, byte-identical to before.
             codec = wire.negotiate_codec(hello.get("codecs"))
-            client = DispatcherClient(self.dispatcher_addr)
+            client = DispatcherClient(self.dispatcher_endpoints)
             while not self._stop.is_set():
                 task = client.request_task(job, self.worker_id, consumer)
                 if task.get("wait"):
@@ -2221,7 +2356,11 @@ class ServiceFeed(object):
         if files is None and attach is not True:
             raise ValueError("files=None needs attach=True (adopting the "
                              "spec of a live job)")
-        self.dispatcher_addr = _addr_tuple(dispatcher_addr)
+        # Endpoint-list discovery (primary first, standbys after): every
+        # DispatcherClient below dials across the list, so the feed
+        # follows a promoted standby without losing ledger state.
+        self.dispatcher_endpoints = normalize_endpoints(dispatcher_addr)
+        self.dispatcher_addr = self.dispatcher_endpoints[0]
         self.files = list(files) if files is not None else None
         self.attach = attach
         self.job_name = job_name
@@ -2287,7 +2426,7 @@ class ServiceFeed(object):
             return
         self._started = True
         client = self.retry_policy.call(
-            lambda: DispatcherClient(self.dispatcher_addr))
+            lambda: DispatcherClient(self.dispatcher_endpoints))
         reply = client.register_job(self.job_name, self.files,
                                     num_epochs=self.num_epochs,
                                     mode=self.mode,
@@ -2325,7 +2464,7 @@ class ServiceFeed(object):
             while not self._stop.is_set():
                 if client is None:
                     try:
-                        client = DispatcherClient(self.dispatcher_addr,
+                        client = DispatcherClient(self.dispatcher_endpoints,
                                                   retries=0)
                     except (OSError, EOFError, TimeoutError,
                             ConnectionError) as e:
@@ -2452,7 +2591,7 @@ class ServiceFeed(object):
         maintainer's client when it is still healthy)."""
         try:
             if client is None:
-                client = DispatcherClient(self.dispatcher_addr, retries=0)
+                client = DispatcherClient(self.dispatcher_endpoints, retries=0)
             try:
                 client.detach_job(self.job_name, self.consumer_id)
             finally:
@@ -2506,7 +2645,7 @@ class ServiceFeed(object):
         """Best-effort LOST report: re-pools the mid-flight split now; the
         worker-fence path remains the backstop if this fails."""
         try:
-            client = DispatcherClient(self.dispatcher_addr)
+            client = DispatcherClient(self.dispatcher_endpoints)
             try:
                 client.lost_split(self.job_name, key[0], key[1], worker_id,
                                   self.consumer_id)
@@ -2720,7 +2859,7 @@ class ServiceFeed(object):
             self._flow_pending.append(int(flow))
         try:
             client = self.retry_policy.call(
-                lambda: DispatcherClient(self.dispatcher_addr))
+                lambda: DispatcherClient(self.dispatcher_endpoints))
             try:
                 client.done_split(self.job_name, key[0], key[1],
                                   self.consumer_id)
@@ -3010,7 +3149,7 @@ class ServiceFeed(object):
 
             def _relay():
                 try:
-                    client = DispatcherClient(self.dispatcher_addr,
+                    client = DispatcherClient(self.dispatcher_endpoints,
                                               retries=0)
                     try:
                         client.push_knobs(

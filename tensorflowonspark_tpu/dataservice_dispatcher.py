@@ -63,33 +63,78 @@ def main(argv=None):
     parser.add_argument("--no-affinity", dest="affinity",
                         action="store_false",
                         help="plain FCFS DYNAMIC scheduling")
+    parser.add_argument("--standby", action="store_true",
+                        help="arm as a warm standby: tail the primary's "
+                             "beacon in --journal-dir and promote when it "
+                             "goes silent past --takeover-after")
+    parser.add_argument("--takeover-after", type=float, default=2.0,
+                        help="beacon silence (seconds) before a standby "
+                             "promotes itself")
+    parser.add_argument("--poll", type=float, default=0.2,
+                        help="standby beacon poll interval seconds")
+    parser.add_argument("--takeover-grace", type=float, default=None,
+                        help="seconds after a recovery during which worker/"
+                             "consumer fencing is suppressed (default: "
+                             "heartbeat × misses, at least 2s)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
 
-    from tensorflowonspark_tpu import dataservice, telemetry
+    from tensorflowonspark_tpu import dataservice, fault, standby, telemetry
 
     tracer = telemetry.configure_from_meta({})
     telemetry.install_sigusr1()
 
-    dispatcher = dataservice.DispatcherServer(
-        heartbeat_interval=args.heartbeat, heartbeat_misses=args.misses,
-        host=args.host, port=args.port, journal_dir=args.journal_dir,
-        snapshot_every=args.snapshot_every, affinity=args.affinity,
-        journal_keep=args.journal_keep,
-        journal_keep_bytes=args.journal_keep_bytes)
-    host, port = dispatcher.start()
-    print("dispatcher ready on {}:{}".format(host, port), flush=True)
+    if args.standby and not args.journal_dir:
+        parser.error("--standby requires --journal-dir (the standby tails "
+                     "the primary's beacon and recovers its ledger there)")
+
+    def build():
+        return dataservice.DispatcherServer(
+            heartbeat_interval=args.heartbeat, heartbeat_misses=args.misses,
+            host=args.host, port=args.port, journal_dir=args.journal_dir,
+            snapshot_every=args.snapshot_every, affinity=args.affinity,
+            journal_keep=args.journal_keep,
+            journal_keep_bytes=args.journal_keep_bytes,
+            takeover_grace=args.takeover_grace)
 
     done = threading.Event()
     signal.signal(signal.SIGTERM, lambda signum, frame: done.set())
+
+    watcher = None
+    dispatcher = None
+    if args.standby:
+        def announce(promoted, addr):
+            print("dispatcher promoted on {}:{} epoch={}".format(
+                addr[0], addr[1], promoted.fencing_epoch), flush=True)
+            fault.from_env().arm_coordinator_kill("dispatcher")
+
+        watcher = standby.WarmStandby(
+            build, args.journal_dir, takeover_after=args.takeover_after,
+            poll_interval=args.poll, on_promote=announce,
+            name="dispatcher").start()
+        print("dispatcher standby armed on {} (takeover after {:.1f}s)"
+              .format(args.journal_dir, args.takeover_after), flush=True)
+    else:
+        dispatcher = build()
+        host, port = dispatcher.start()
+        print("dispatcher ready on {}:{}".format(host, port), flush=True)
+        # Chaos scripting: kill_coordinator_after_secs in TFOS_FAULT_SPEC
+        # SIGKILLs this process on schedule, like node faults kill nodes.
+        fault.from_env().arm_coordinator_kill("dispatcher")
+
     try:
         done.wait()
     except KeyboardInterrupt:
         pass
-    dispatcher.stop()
+    if watcher is not None:
+        watcher.stop()
+        if watcher.server is not None:
+            watcher.server.stop()
+    if dispatcher is not None:
+        dispatcher.stop()
     tracer.flush()
     return 0
 

@@ -218,6 +218,9 @@ class _NullInjector(object):
     def arm_preempt_notice(self):
         pass
 
+    def arm_coordinator_kill(self, role=None):
+        pass
+
     def corrupt_checkpoint(self, directory):
         pass
 
@@ -241,6 +244,13 @@ class FaultInjector(object):
       gives; :meth:`arm_preempt_notice` (called when the node's user fn
       starts) arms a timer that SIGTERMs the process after that delay —
       the cloud-provider "instance going away in N seconds" shape.
+    - ``kill_coordinator_after_secs``: SIGKILL a *coordinator* process
+      (reservation server or data-service dispatcher) that long after
+      :meth:`arm_coordinator_kill` is called at its startup — scripts
+      coordinator death like node death, so chaos runs exercise the
+      warm-standby takeover path.  Optional ``coordinator_role``
+      (``"reservation"`` / ``"dispatcher"``) restricts which coordinator
+      the spec fires in, the way ``executor_id`` targets node faults.
     - ``fail_after_items``: raise :class:`InjectedFailure` (``message``)
       once N items were consumed (a user-code failure at step N).
     - ``corrupt_checkpoint``: garble the newest checkpoint step directory
@@ -460,6 +470,33 @@ class FaultInjector(object):
             os.kill(os.getpid(), signal.SIGTERM)
 
         t = threading.Timer(delay, _notify)
+        t.daemon = True
+        t.start()
+
+    def arm_coordinator_kill(self, role=None):
+        """Arm the ``kill_coordinator_after_secs`` timer: a daemon timer
+        SIGKILLs this process after the configured delay — an unannounced
+        coordinator death the warm standby must turn into a takeover.
+        Call once at coordinator startup (the reservation-server and
+        dispatcher CLIs do), passing this process's ``role``; a spec with
+        ``coordinator_role`` set fires only in the matching coordinator."""
+        delay = self.spec.get("kill_coordinator_after_secs")
+        if not delay:
+            return
+        target = self.spec.get("coordinator_role")
+        if target is not None and role is not None and target != role:
+            return
+        self.spec.pop("kill_coordinator_after_secs")  # arm once
+        import threading
+
+        def _kill():
+            logger.warning("FaultInjector: killing %s coordinator pid %d "
+                           "after %.1fs", role or "?", os.getpid(), delay)
+            self._fired("kill_coordinator", flush=True, role=role,
+                        delay_secs=delay)
+            self._kill_self()
+
+        t = threading.Timer(delay, _kill)
         t.daemon = True
         t.start()
 

@@ -614,7 +614,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
         port_sock, port = _reserve_free_port()
 
         host = util.get_ip_address()
-        client = reservation.Client(cluster_meta["server_addr"])
+        client = reservation.Client(
+            cluster_meta.get("server_addrs") or cluster_meta["server_addr"])
         node_meta = {
             "executor_id": executor_id,
             "host": host,
@@ -710,7 +711,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             # user-code exceptions, which travel via the error queue) send
             # BYE so they are not miscounted as deaths.
             hb = reservation.HeartbeatSender(
-                cluster_meta["server_addr"], executor_id,
+                cluster_meta.get("server_addrs")
+                or cluster_meta["server_addr"], executor_id,
                 heartbeat_interval,
                 metrics_provider=_node_metrics_provider(context.mgr),
                 trace_flow=node_meta.get("trace_flow"),
@@ -793,7 +795,8 @@ def run(fn, tf_args, cluster_meta, tensorboard=False, log_dir=None,
             errq = mgr.get_queue("error")
             mgr.set("node_pid", os.getpid())
             hb = reservation.HeartbeatSender(
-                cluster_meta["server_addr"], executor_id,
+                cluster_meta.get("server_addrs")
+                or cluster_meta["server_addr"], executor_id,
                 heartbeat_interval,
                 metrics_provider=_node_metrics_provider(mgr),
                 trace_flow=node_meta.get("trace_flow"),
@@ -923,7 +926,9 @@ def train(cluster_info, cluster_meta, qname="input", feed_timeout=600,
         # If the consumer began terminating while we fed, ask the driver to
         # stop scheduling feed partitions (reference TFSparkNode.py:422-434).
         if mgr.get("state") == "terminating":
-            client = reservation.Client(cluster_meta["server_addr"])
+            client = reservation.Client(
+                cluster_meta.get("server_addrs")
+                or cluster_meta["server_addr"])
             client.request_stop()
             client.close()
         return [count]

@@ -300,7 +300,8 @@ def _render_histogram(fams, executor, counters):
 
 def render_prometheus(snapshot, ring=None, window_secs=60.0,
                       scrapes=None, alert_counts=None, info=None,
-                      autopilot_counts=None, autopilot_ticks=None):
+                      autopilot_counts=None, autopilot_ticks=None,
+                      coordinator=None):
     """Prometheus text exposition (0.0.4) from one metrics snapshot.
 
     ``snapshot`` is the ``{"nodes": {id: counters}, "aggregate": {...}}``
@@ -351,6 +352,40 @@ def render_prometheus(snapshot, ring=None, window_secs=60.0,
         fams.add("tfos_autopilot_ticks_total", "counter",
                  "Autopilot controller ticks executed.",
                  "tfos_autopilot_ticks_total %d" % autopilot_ticks)
+    if coordinator:
+        # Coordinator-HA plane (reservation.Server.ha_status): fencing
+        # epoch, journal footprint, recovery/supersession state — the
+        # takeover alert keys off tfos_coordinator_epoch increasing.
+        fams.add("tfos_coordinator_epoch", "gauge",
+                 "Fencing epoch of the serving coordinator (bumps on "
+                 "every restart-in-place or standby takeover; 0 = "
+                 "journal-less).",
+                 "tfos_coordinator_epoch %s"
+                 % _fmt_value(coordinator.get("epoch") or 0))
+        fams.add("tfos_coordinator_journal_records_total", "counter",
+                 "Ledger mutation records appended by this coordinator "
+                 "incarnation.",
+                 "tfos_coordinator_journal_records_total %s"
+                 % _fmt_value(coordinator.get("journal_records") or 0))
+        fams.add("tfos_coordinator_snapshots_total", "counter",
+                 "Journal snapshot generations cut (sequence number).",
+                 "tfos_coordinator_snapshots_total %s"
+                 % _fmt_value(coordinator.get("snapshot_seq") or 0))
+        fams.add("tfos_coordinator_recovered_nodes", "gauge",
+                 "Roster entries restored from the journal at this "
+                 "incarnation's start.",
+                 "tfos_coordinator_recovered_nodes %s"
+                 % _fmt_value(coordinator.get("recovered_nodes") or 0))
+        fams.add("tfos_coordinator_superseded", "gauge",
+                 "1 when this coordinator was fenced by a successor's "
+                 "epoch (zombie; all requests answered ERR).",
+                 "tfos_coordinator_superseded %d"
+                 % (1 if coordinator.get("superseded_by") else 0))
+        fams.add("tfos_coordinator_grace_remaining_seconds", "gauge",
+                 "Seconds left in the post-takeover window during which "
+                 "node liveness fencing is suppressed.",
+                 "tfos_coordinator_grace_remaining_seconds %s"
+                 % _fmt_value(coordinator.get("grace_remaining_secs") or 0))
 
     for executor in sorted(nodes):
         counters = nodes[executor]
@@ -406,7 +441,8 @@ class ObservatoryServer(object):
     def __init__(self, snapshot_fn, ring=None, status_fn=None,
                  host="0.0.0.0", port=0, window_secs=60.0,
                  profile_fn=None, profiler_addresses_fn=None,
-                 capture_status_fn=None, watchtower=None, autopilot=None):
+                 capture_status_fn=None, watchtower=None, autopilot=None,
+                 coordinator_fn=None):
         """``profile_fn(duration_ms=, steps=)`` backs ``GET /profile``
         (typically ``CaptureCoordinator.trigger``; 503 when absent).
         ``profiler_addresses_fn`` / ``capture_status_fn`` enrich ``/status``
@@ -416,9 +452,14 @@ class ObservatoryServer(object):
         ``GET /alerts``, the ``/status`` watchtower block, and the
         ``tfos_alerts_total`` counters on ``/metrics``.  ``autopilot`` (an
         ``autopilot.Autopilot``) backs ``GET /autopilot``, the ``/status``
-        autopilot block, and the ``tfos_autopilot_*`` counters."""
+        autopilot block, and the ``tfos_autopilot_*`` counters.
+        ``coordinator_fn`` (typically ``reservation.Server.ha_status``)
+        backs the ``/status`` coordinator block and the
+        ``tfos_coordinator_*`` metrics (fencing epoch, journal footprint,
+        takeover grace)."""
         self._snapshot_fn = snapshot_fn
         self._status_fn = status_fn
+        self._coordinator_fn = coordinator_fn
         self._profile_fn = profile_fn
         self._profiler_addresses_fn = profiler_addresses_fn
         self._capture_status_fn = capture_status_fn
@@ -464,13 +505,20 @@ class ObservatoryServer(object):
             except Exception:
                 autopilot_counts = None
                 autopilot_ticks = None
+        coordinator = None
+        if self._coordinator_fn is not None:
+            try:
+                coordinator = self._coordinator_fn()
+            except Exception:
+                coordinator = None
         return render_prometheus(snapshot, ring=self.ring,
                                  window_secs=self._window_secs,
                                  scrapes=self._scrapes,
                                  alert_counts=alert_counts,
                                  info=self._build_info,
                                  autopilot_counts=autopilot_counts,
-                                 autopilot_ticks=autopilot_ticks)
+                                 autopilot_ticks=autopilot_ticks,
+                                 coordinator=coordinator)
 
     def _alerts_json(self, query):
         if self.watchtower is None:
@@ -559,6 +607,11 @@ class ObservatoryServer(object):
                 payload["autopilot"] = self.autopilot.status()
             except Exception:
                 payload["autopilot"] = None
+        if self._coordinator_fn is not None:
+            try:
+                payload["coordinator"] = self._coordinator_fn()
+            except Exception:
+                payload["coordinator"] = None
         # tf_status may hold arbitrary user values; never let one break
         # the endpoint
         return json.dumps(payload, default=str)

@@ -32,6 +32,7 @@ import struct
 import threading
 import time
 
+from tensorflowonspark_tpu import standby as standby_mod
 from tensorflowonspark_tpu import telemetry
 
 logger = logging.getLogger(__name__)
@@ -43,6 +44,45 @@ TFOS_SERVER_PORT = "TFOS_SERVER_PORT"
 _HEADER = struct.Struct(">I")  # 4-byte big-endian length prefix
 
 _UNSET = object()  # sentinel: "use the client's default request timeout"
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        logger.warning("ignoring non-integer %s=%r", name, raw)
+        return default
+
+
+def normalize_endpoints(addr):
+    """Normalize a control-plane address into an endpoint LIST.
+
+    Accepts a single ``(host, port)`` / ``[host, port]`` / ``"host:port"``,
+    or a sequence of them — the endpoint-list form coordinator HA uses:
+    entry 0 is the primary, later entries are warm standbys at pre-agreed
+    pinned ports.  Clients dial in order and redial across the list on a
+    reset, so a promoted standby is reachable without reconfiguration.
+    """
+    def one(a):
+        if isinstance(a, str):
+            host, _, port = a.rpartition(":")
+            return (host, int(port))
+        return (a[0], int(a[1]))
+
+    if isinstance(addr, str):
+        return [one(addr)]
+    seq = list(addr)
+    if (len(seq) == 2 and isinstance(seq[0], str)
+            and not isinstance(seq[1], (list, tuple))
+            and (isinstance(seq[1], int)
+                 or (isinstance(seq[1], str) and seq[1].isdigit()))):
+        return [one(seq)]  # a bare (host, port) pair
+    if not seq:
+        raise ValueError("empty endpoint list")
+    return [one(a) for a in seq]
 
 
 class Reservations(object):
@@ -226,7 +266,10 @@ class Server(MessageSocket):
     """
 
     def __init__(self, count, heartbeat_interval=0, heartbeat_misses=3,
-                 on_dead=None, on_bye=None):
+                 on_dead=None, on_bye=None, host=None, port=None,
+                 journal_dir=None, snapshot_every=None, journal_keep=None,
+                 journal_keep_bytes=None, beacon_interval=None,
+                 takeover_grace=None):
         """Args:
           count: required number of reservations.
           heartbeat_interval: expected seconds between node ``HBEAT``s;
@@ -241,6 +284,28 @@ class Server(MessageSocket):
             clean ``BYE`` deregistration that carries a reason (``done`` /
             ``preempted``) — how the driver tells clean completion from a
             preemption drain in ``tf_status``.
+          host/port: advertised host and listen port (default: the
+            ``TFOS_SERVER_HOST``/``TFOS_SERVER_PORT`` env, then
+            auto-detect/ephemeral).  Pin the port so a restarted or
+            promoted coordinator keeps a pre-agreed address.
+          journal_dir: journal every ledger mutation (REG, slot
+            release/reclaim, BYE, fence, knob push, STOP) as
+            flush-per-write JSONL under this dir, with periodic
+            tmp+rename+fsync snapshots; ``start()`` then advances the
+            fencing epoch and recovers roster, generations, released
+            slots, latched metrics, and KnobCoordinator state before
+            listening.  Default: ``TFOS_RS_JOURNAL_DIR`` env; unset
+            disables durability (the historic in-memory behavior).
+          snapshot_every / journal_keep / journal_keep_bytes: snapshot
+            cadence and compaction policy, mirroring the data-service
+            dispatcher (env fallbacks ``TFOS_RS_SNAPSHOT_EVERY`` 256,
+            ``TFOS_RS_JOURNAL_KEEP`` 2, ``TFOS_RS_JOURNAL_KEEP_BYTES``).
+          beacon_interval: primary-beacon stamp cadence (None: half the
+            heartbeat interval, clamped to [0.1, 0.5]s).
+          takeover_grace: seconds after a recovery during which liveness
+            fencing is suppressed so healthy nodes can re-home to the new
+            coordinator (None: ``heartbeat_interval × heartbeat_misses``,
+            at least 2 s).
         """
         assert count > 0
         self.reservations = Reservations(count)
@@ -249,6 +314,40 @@ class Server(MessageSocket):
         self.heartbeat_misses = heartbeat_misses
         self.on_dead = on_dead
         self.on_bye = on_bye
+        self._host = host
+        self._port = port
+        if journal_dir is None:
+            journal_dir = os.environ.get("TFOS_RS_JOURNAL_DIR") or None
+        self.journal_dir = journal_dir
+        if snapshot_every is None:
+            snapshot_every = _env_int("TFOS_RS_SNAPSHOT_EVERY", 256)
+        self.snapshot_every = max(int(snapshot_every), 1)
+        if journal_keep is None:
+            journal_keep = _env_int("TFOS_RS_JOURNAL_KEEP", 2)
+        self.journal_keep = max(int(journal_keep), 1)
+        if journal_keep_bytes is None:
+            journal_keep_bytes = _env_int("TFOS_RS_JOURNAL_KEEP_BYTES", 0)
+        self.journal_keep_bytes = max(int(journal_keep_bytes), 0)
+        if beacon_interval is None:
+            beacon_interval = (min(max(heartbeat_interval / 2.0, 0.1), 0.5)
+                               if heartbeat_interval else 0.5)
+        self.beacon_interval = float(beacon_interval)
+        self._takeover_grace = takeover_grace
+        # Fencing epoch: 0 until this incarnation claims a journal dir.
+        # Replies carry it (the send() override) so clients can refuse a
+        # zombie's stale answers; superseded_by latches the NEWER epoch a
+        # successor stamped, after which every request is answered ERR.
+        self.fencing_epoch = 0
+        self.superseded_by = None
+        self.recovered_nodes = 0   # roster entries restored at start()
+        self.recoveries = 0        # 1 when this incarnation recovered state
+        self.journal_records = 0   # total ledger records appended (metrics)
+        self._journal_file = None
+        self._journal_seq = 0
+        self._journal_count = 0
+        self._journal_lock = threading.Lock()  # push_knobs runs off-thread
+        self._beacon_last = 0.0
+        self._fence_grace_until = 0.0
         self._stopping = False  # set by stop(): winds the listener down
         self._socket = None
         self._thread = None
@@ -325,7 +424,367 @@ class Server(MessageSocket):
         meta = self.reservations.release(executor_id)
         if meta is not None:
             self._released_ids.add(executor_id)
+            self._journal({"t": "release", "executor": executor_id})
         return meta
+
+    # -- fencing epoch + reply stamping -----------------------------------
+
+    def send(self, sock, msg):
+        """Every reply from a journal-armed coordinator carries the fencing
+        epoch, so clients can tell a promoted successor (higher epoch) from
+        a zombie predecessor (lower) and refuse to go backwards."""
+        if self.fencing_epoch and isinstance(msg, dict):
+            msg.setdefault("epoch", self.fencing_epoch)
+        MessageSocket.send(self, sock, msg)
+
+    def _check_epoch(self):
+        """Ledger-ownership check: a fencing epoch on disk newer than ours
+        means a successor (restart or promoted standby) claimed the
+        journal — fence THIS incarnation as a zombie: stop journaling,
+        stop stamping the beacon, answer everything ERR."""
+        if not self.journal_dir or self.superseded_by is not None:
+            return
+        on_disk = standby_mod.read_epoch(self.journal_dir)
+        if on_disk > self.fencing_epoch:
+            self._fence_zombie(on_disk)
+
+    def _fence_zombie(self, newer_epoch):
+        self.superseded_by = newer_epoch
+        logger.error(
+            "reservation server fenced: epoch %d on disk supersedes this "
+            "incarnation's epoch %d — a successor owns the ledger; "
+            "rejecting all writes from here on", newer_epoch,
+            self.fencing_epoch)
+        telemetry.get_tracer().instant(
+            "reservation/zombie_fenced", epoch=self.fencing_epoch,
+            superseded_by=newer_epoch)
+        with self._journal_lock:
+            if self._journal_file is not None:
+                try:
+                    self._journal_file.close()
+                except OSError:
+                    pass
+                self._journal_file = None
+
+    def _stamp_beacon(self, addr, force=False):
+        """Re-stamp the primary beacon at the configured cadence (listener
+        loop tick); doubles as the zombie self-check — a superseded
+        incarnation must not keep the beacon looking alive."""
+        if not self.journal_dir or self.superseded_by is not None:
+            return
+        now = time.monotonic()
+        if not force and now - self._beacon_last < self.beacon_interval:
+            return
+        self._beacon_last = now
+        self._check_epoch()
+        if self.superseded_by is None:
+            standby_mod.write_beacon(self.journal_dir, self.fencing_epoch,
+                                     host=addr[0], port=addr[1],
+                                     role="reservation")
+
+    # -- journal -----------------------------------------------------------
+
+    def _segment_path(self, kind, seq):
+        ext = "jsonl" if kind == "journal" else "json"
+        return os.path.join(self.journal_dir,
+                            "{}-{:08d}.{}".format(kind, seq, ext))
+
+    def _journal(self, rec):
+        """Append one ledger-mutation record, flush-per-write (a SIGKILL
+        loses at most the torn tail line, skipped on replay).  Each append
+        re-verifies ledger ownership via the fencing epoch, so a zombie
+        primary's writes are REJECTED rather than interleaved with its
+        successor's.  A write failure degrades to in-memory operation with
+        a loud log — availability over durability."""
+        if self._journal_file is None:
+            return
+        with self._journal_lock:
+            if self._journal_file is None:
+                return
+            on_disk = standby_mod.read_epoch(self.journal_dir)
+            if on_disk > self.fencing_epoch:
+                pass  # fenced below, outside the lock
+            else:
+                try:
+                    self._journal_file.write(
+                        json.dumps(rec, sort_keys=True) + "\n")
+                    self._journal_file.flush()
+                except (OSError, ValueError) as e:
+                    logger.error(
+                        "reservation journal: write failed (%s); ledger "
+                        "durability is LOST until restart", e)
+                    try:
+                        self._journal_file.close()
+                    except OSError:
+                        pass
+                    self._journal_file = None
+                    return
+                self.journal_records += 1
+                self._journal_count += 1
+                if self._journal_count >= self.snapshot_every:
+                    self._write_snapshot_locked()
+                return
+        self._fence_zombie(on_disk)
+
+    def _snapshot_state(self):
+        """JSON-serializable full ledger state.  Latched node metrics ride
+        snapshots (not per-beat journal records — beats are too chatty for
+        flush-per-write), plus the final BYE metrics which ARE journaled;
+        a failover loses at most one beat's worth of counter freshness,
+        which the cumulative node-side counters repair on the next beat."""
+        res = self.reservations
+        with res._lock:
+            roster = list(res._reservations)
+            released = [list(s) for s in res._released]
+            generation = res.generation
+        state = {
+            "seq": self._journal_seq,
+            "epoch": self.fencing_epoch,
+            "required": res.required,
+            "generation": generation,
+            "reservations": roster,
+            "released": released,
+            "released_ids": sorted(str(x) for x in self._released_ids),
+            "dead": dict(self._dead),
+            "byes": dict(self._byes),
+            "node_metrics": {str(ex): dict(snap)
+                             for ex, snap in list(self._node_metrics.items())},
+            "done": bool(self.done),
+        }
+        if self.knob_coordinator is not None:
+            state["knobs"] = self.knob_coordinator.to_state()
+        return state
+
+    def _write_snapshot_locked(self):
+        """Full-state snapshot (atomic tmp+rename+fsync) + fresh journal
+        segment; old generations pruned per the compaction policy.  Caller
+        holds ``_journal_lock``."""
+        self._journal_seq += 1
+        seq = self._journal_seq
+        state = self._snapshot_state()
+        state["seq"] = seq
+        path = self._segment_path("snapshot", seq)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            if self._journal_file is not None:
+                self._journal_file.close()
+            self._journal_file = open(self._segment_path("journal", seq), "a")
+        except OSError as e:
+            logger.error("reservation journal: snapshot %d failed (%s)",
+                         seq, e)
+            self._journal_file = None
+        self._journal_count = 0
+        self._prune_segments(seq)
+
+    def _gen_bytes(self, seq):
+        total = 0
+        for kind in ("snapshot", "journal"):
+            try:
+                total += os.path.getsize(self._segment_path(kind, seq))
+            except OSError:
+                pass
+        return total
+
+    def _prune_segments(self, seq):
+        """Byte budget (``journal_keep_bytes`` > 0): keep the newest
+        generations that fit, the newest always kept; otherwise keep the
+        newest ``journal_keep`` generations."""
+        if self.journal_keep_bytes:
+            keep = {seq}
+            total = self._gen_bytes(seq)
+            for s in range(seq - 1, 0, -1):
+                total += self._gen_bytes(s)
+                if total > self.journal_keep_bytes:
+                    break
+                keep.add(s)
+            oldest_kept = min(keep)
+        else:
+            oldest_kept = seq - self.journal_keep + 1
+        for old in range(1, oldest_kept):
+            for kind in ("snapshot", "journal"):
+                try:
+                    os.unlink(self._segment_path(kind, old))
+                except OSError:
+                    pass
+
+    def _list_segments(self):
+        out = []
+        for name in os.listdir(self.journal_dir):
+            if name.startswith("journal-") and name.endswith(".jsonl"):
+                try:
+                    out.append(int(name[len("journal-"):-len(".jsonl")]))
+                except ValueError:
+                    pass
+        return out
+
+    def _replay(self, rec):
+        """Apply one journal record through the same mutation paths as the
+        live handlers, so replay and live execution cannot diverge."""
+        t = rec.get("t")
+        if t == "reg":
+            meta = rec.get("meta")
+            try:
+                self.reservations.add(meta)
+            except ValueError:
+                pass  # already present via the snapshot base
+            gen = rec.get("generation")
+            if gen is not None:
+                self.reservations.generation = max(
+                    self.reservations.generation, int(gen))
+        elif t == "release":
+            if self.reservations.release(rec.get("executor")) is not None:
+                self._released_ids.add(rec.get("executor"))
+        elif t == "fence":
+            ex = rec.get("executor")
+            self._dead[ex] = rec.get(
+                "why", "fenced before a coordinator failover")
+            self._beats.pop(ex, None)
+        elif t == "bye":
+            ex = rec.get("executor")
+            self._latch_metrics(ex, rec.get("metrics"))
+            self._beats.pop(ex, None)
+            if rec.get("reason") is not None:
+                self._byes[ex] = rec["reason"]
+        elif t == "knob":
+            if self.knob_coordinator is None:
+                self.knob_coordinator = KnobCoordinator()
+            self.knob_coordinator.push(rec.get("knobs") or {},
+                                       executor_id=rec.get("target"))
+        elif t == "stop":
+            self.done = True
+
+    def _recover(self):
+        """Rebuild roster, generations, released slots, latched metrics and
+        KnobCoordinator state from the newest snapshot plus its journal
+        segment (torn tail tolerated), re-arm liveness for the recovered
+        roster under a takeover grace window, and cut a fresh snapshot so
+        the NEXT restart replays from here."""
+        os.makedirs(self.journal_dir, exist_ok=True)
+        seqs = []
+        for name in os.listdir(self.journal_dir):
+            if name.startswith("snapshot-") and name.endswith(".json"):
+                try:
+                    seqs.append(int(name[len("snapshot-"):-len(".json")]))
+                except ValueError:
+                    pass
+        seq = max(seqs) if seqs else 0
+        if seq:
+            try:
+                with open(self._segment_path("snapshot", seq)) as f:
+                    state = json.load(f)
+                res = self.reservations
+                with res._lock:
+                    res._reservations = list(state.get("reservations") or [])
+                    res._released = [tuple(s) for s
+                                     in (state.get("released") or [])]
+                    res.generation = int(state.get("generation", 0))
+                self._released_ids = set(state.get("released_ids") or [])
+                self._dead = dict(state.get("dead") or {})
+                self._byes = dict(state.get("byes") or {})
+                self._node_metrics = {
+                    ex: dict(snap) for ex, snap
+                    in (state.get("node_metrics") or {}).items()}
+                self.done = bool(state.get("done"))
+                if state.get("knobs"):
+                    self.knob_coordinator = KnobCoordinator.from_state(
+                        state["knobs"])
+                self._journal_seq = int(state.get("seq", seq))
+            except (OSError, ValueError, KeyError) as e:
+                logger.error("reservation journal: snapshot %d unreadable "
+                             "(%s); replaying the journal from scratch",
+                             seq, e)
+                self._journal_seq = seq
+        replayed = 0
+        for jseq in sorted(s for s in self._list_segments() if s >= seq):
+            try:
+                with open(self._segment_path("journal", jseq)) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            break  # torn tail record from the SIGKILL
+                        self._replay(rec)
+                        replayed += 1
+            except OSError:
+                continue
+        # Re-arm liveness for the recovered roster at "now", under a grace
+        # window suppressing fencing entirely: the nodes are (probably)
+        # alive, but their beats were landing on the dead predecessor —
+        # fencing them for that silence would turn one coordinator death
+        # into a cluster-wide false-fence cascade while they re-home.
+        roster = self.reservations.get()
+        now = time.monotonic()
+        if self.heartbeat_interval:
+            for meta in roster:
+                if isinstance(meta, dict) \
+                        and meta.get("executor_id") is not None \
+                        and meta["executor_id"] not in self._dead:
+                    self._beats[meta["executor_id"]] = (now, meta)
+        self.recovered_nodes = len(roster)
+        if roster or replayed or seq:
+            self.recoveries = 1
+            grace = self._takeover_grace
+            if grace is None:
+                grace = max(
+                    self.heartbeat_interval * self.heartbeat_misses, 2.0)
+            self._fence_grace_until = now + grace
+            logger.warning(
+                "reservation server: recovered %d node(s), generation %d "
+                "from %s (snapshot %d + %d journal record(s)); fencing "
+                "suppressed for %.1fs while nodes re-home",
+                len(roster), self.reservations.generation, self.journal_dir,
+                seq, replayed, grace)
+            telemetry.get_tracer().instant(
+                "reservation/recover", nodes=len(roster), records=replayed,
+                generation=self.reservations.generation,
+                epoch=self.fencing_epoch)
+        with self._journal_lock:
+            self._write_snapshot_locked()
+
+    # -- knob plane --------------------------------------------------------
+
+    def push_knobs(self, knobs, executor_id=None):
+        """Journaled knob push: queue a live-knob update for the fleet (or
+        one executor) AND record it in the ledger, so a recovered or
+        promoted coordinator still carries the autopilot's standing intent
+        — nodes that re-home drain the same history they would have from
+        the dead primary.  The autopilot's actuator in a journal-armed
+        cluster (``cluster.run``) points here instead of at the bare
+        ``KnobCoordinator.push``."""
+        if self.knob_coordinator is None:
+            self.knob_coordinator = KnobCoordinator()
+        seq = self.knob_coordinator.push(knobs, executor_id=executor_id)
+        if knobs:
+            self._journal({"t": "knob", "seq": seq, "knobs": dict(knobs),
+                           "target": executor_id})
+        return seq
+
+    # -- HA observability --------------------------------------------------
+
+    def ha_status(self):
+        """The coordinator-HA block for ``/status`` and the
+        ``tfos_coordinator_*`` metrics: journal armament, fencing epoch,
+        supersession, recovery footprint, and the remaining takeover
+        grace."""
+        return {
+            "journal_dir": self.journal_dir,
+            "epoch": self.fencing_epoch,
+            "superseded_by": self.superseded_by,
+            "recovered_nodes": self.recovered_nodes,
+            "recoveries": self.recoveries,
+            "journal_records": self.journal_records,
+            "snapshot_seq": self._journal_seq,
+            "grace_remaining_secs": round(
+                max(0.0, self._fence_grace_until - time.monotonic()), 3),
+        }
 
     def _watch(self, meta):
         """Start tracking a registered node (registration counts as beat 0,
@@ -381,8 +840,13 @@ class Server(MessageSocket):
         deadline, fire ``on_dead``, and wake roster waiters immediately."""
         if not self.heartbeat_interval or self.done:
             return
-        deadline = self.heartbeat_interval * self.heartbeat_misses
         now = time.monotonic()
+        if now < self._fence_grace_until:
+            # Post-takeover grace: this incarnation just recovered the
+            # roster from the journal; the nodes' beats were landing on the
+            # dead predecessor, so their silence is OUR history, not theirs.
+            return
+        deadline = self.heartbeat_interval * self.heartbeat_misses
         newly_dead = []
         for executor_id, (last, meta) in list(self._beats.items()):
             age = now - last
@@ -396,6 +860,8 @@ class Server(MessageSocket):
                 logger.error("liveness: %s", desc)
                 self._dead[executor_id] = desc
                 del self._beats[executor_id]
+                self._journal({"t": "fence", "executor": executor_id,
+                               "why": desc})
                 newly_dead.append((meta, age))
                 telemetry.get_tracer().instant(
                     "reservation/fence", executor_id=executor_id,
@@ -504,6 +970,23 @@ class Server(MessageSocket):
         Returns False if the connection should be closed.
         """
         mtype = msg.get("type")
+        if mtype in ("REG", "HBEAT", "BYE", "STOP", "PROF"):
+            # Mutating request: re-verify ledger ownership FIRST, so a
+            # zombie primary never mutates in-memory state (and replies OK)
+            # for a write its successor will not have.
+            self._check_epoch()
+        if self.superseded_by is not None:
+            # "superseded" is a STRUCTURED marker, not just error text:
+            # clients must tell this ERR (redial toward the successor)
+            # from a liveness fence ERR (stop beating and terminate).
+            self.send(sock, {
+                "type": "ERR", "epoch": self.superseded_by,
+                "superseded": self.superseded_by,
+                "error": "coordinator superseded: epoch {} claimed the "
+                         "ledger (this incarnation was epoch {}); redial "
+                         "the promoted coordinator".format(
+                             self.superseded_by, self.fencing_epoch)})
+            return True
         if mtype == "REG":
             meta = msg["data"]
             # Zombie fence: a fenced executor_id must never re-enter the
@@ -525,6 +1008,11 @@ class Server(MessageSocket):
                 self.send(sock, {"type": "ERR", "error": str(e)})
                 return True
             self._watch(meta)
+            # One record carries the admission AND the generation it
+            # produced (replacement admissions bump it), so replay restores
+            # both without re-deriving slot-claim order.
+            self._journal({"t": "reg", "meta": meta,
+                           "generation": self.reservations.generation})
             # Trace-context hop: the node started a flow before dialing
             # (node.run plants "trace_flow" in its meta); stepping it here
             # draws the Perfetto arrow node-register -> driver-admission
@@ -594,6 +1082,11 @@ class Server(MessageSocket):
             if executor_id is not None:
                 self._latch_metrics(executor_id, data.get("metrics"))
                 self._forget(executor_id, reason=data.get("reason"))
+                # Final counters ride the BYE record: a failover right
+                # after a node finishes must not lose its totals.
+                self._journal({"t": "bye", "executor": executor_id,
+                               "reason": data.get("reason"),
+                               "metrics": data.get("metrics")})
                 telemetry.get_tracer().instant(
                     "reservation/bye", executor_id=executor_id,
                     reason=data.get("reason"))
@@ -636,7 +1129,29 @@ class Server(MessageSocket):
         elif mtype == "STOP":
             logger.info("stop requested by client")
             self.done = True
+            self._journal({"t": "stop"})
             self.send(sock, {"type": "OK"})
+        elif mtype == "STATE":
+            # Coordinator-state probe (CI gates, operators, tests): one
+            # read answers "who owns the ledger and what does it hold".
+            res = self.reservations
+            agg = {}
+            for snap in self.metrics_snapshot().values():
+                for k, v in snap.items():
+                    if isinstance(v, (int, float)):
+                        agg[k] = agg.get(k, 0) + v
+            self.send(sock, {
+                "type": "STATE",
+                "generation": res.generation,
+                "registered": res.required - res.remaining(),
+                "required": res.required,
+                "dead": dict(self._dead),
+                "byes": dict(self._byes),
+                "released": sorted(str(x) for x in self._released_ids),
+                "done": bool(self.done),
+                "metrics": agg,
+                "ha": self.ha_status(),
+            })
         else:
             logger.warning("ignoring unknown message type: %r", mtype)
             self.send(sock, {"type": "ERR", "error": "unknown message type"})
@@ -646,15 +1161,26 @@ class Server(MessageSocket):
         """Bind, spawn the daemon listener thread, return ``(host, port)``."""
         self._socket = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._socket.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        port = int(os.environ.get(TFOS_SERVER_PORT, 0))
+        if self._port is not None:
+            port = int(self._port)
+        else:
+            port = int(os.environ.get(TFOS_SERVER_PORT, 0))
         self._socket.bind(("", port))
         self._socket.listen(64)
-        host = os.environ.get(TFOS_SERVER_HOST)
+        host = self._host or os.environ.get(TFOS_SERVER_HOST)
         if not host:
             from tensorflowonspark_tpu import util
 
             host = util.get_ip_address()
         addr = (host, self._socket.getsockname()[1])
+
+        if self.journal_dir:
+            # Claim the ledger BEFORE serving: the epoch bump fences any
+            # prior incarnation, recovery restores its state, and only then
+            # does the beacon advertise this address as primary.
+            self.fencing_epoch = standby_mod.advance_epoch(self.journal_dir)
+            self._recover()
+            self._stamp_beacon(addr, force=True)
 
         def _listen():
             conns = [self._socket]
@@ -700,6 +1226,19 @@ class Server(MessageSocket):
                             pass
                         del parked[sock]
                 self._check_liveness()
+                self._stamp_beacon(addr)
+            # Teardown: close every accepted connection (parked AWAITs
+            # included) so clients get a prompt EOF instead of hanging on
+            # a dead coordinator until their own timeouts — a parked
+            # waiter fails over to the endpoint list the moment its
+            # connection resets.
+            for sock in conns:
+                if sock is not self._socket:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            parked.clear()
 
         self._thread = threading.Thread(
             target=_listen, name="reservation-server", daemon=True
@@ -712,10 +1251,28 @@ class Server(MessageSocket):
         """Ask the listener thread to wind down and close the listen socket."""
         self._stopping = True
         if self._socket is not None:
+            # shutdown() BEFORE close(): the listener thread's select()
+            # holds a kernel reference to the listen socket, so a bare
+            # close() leaves the port accepting (then resetting)
+            # connections for up to one poll timeout — long enough for a
+            # failing-over client to waste a dial on the corpse.  shutdown
+            # acts on the socket itself and refuses new connections
+            # immediately.
+            try:
+                self._socket.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._socket.close()
             except OSError:
                 pass
+        with self._journal_lock:
+            if self._journal_file is not None:
+                try:
+                    self._journal_file.close()
+                except OSError:
+                    pass
+                self._journal_file = None
 
 
 #: Default control-plane request timeout.  A finite default matters: with
@@ -726,15 +1283,41 @@ class Server(MessageSocket):
 DEFAULT_REQUEST_TIMEOUT = 30.0
 
 
+#: Request types a client may transparently re-send on a fresh connection
+#: after a reset: idempotent against the server ledger.  ``REG`` is NOT —
+#: a duplicate registration is rejected by identity, so a REG whose reply
+#: was lost must surface the error to the caller, not be blindly retried.
+_IDEMPOTENT_TYPES = frozenset(
+    {"HBEAT", "BYE", "QUERY", "QINFO", "STOP", "PROF", "STATE", "STATUS"})
+
+
 class Client(MessageSocket):
-    """Executor-side rendezvous client (reference ``reservation.py:205-272``)."""
+    """Executor-side rendezvous client (reference ``reservation.py:205-272``).
+
+    ``server_addr`` may be a single ``(host, port)`` / ``"host:port"`` or a
+    LIST of endpoints — entry 0 the primary, later entries warm standbys at
+    pre-agreed pinned ports.  On a connection reset (primary died) the
+    client redials across the list and, for idempotent request types,
+    transparently re-sends; replies carry the server's fencing epoch, and
+    a reply with a LOWER epoch than the highest already seen is a zombie's
+    — the client drops that connection and redials rather than trusting it.
+    """
 
     def __init__(self, server_addr, retries=3, retry_delay=1.0,
                  request_timeout=DEFAULT_REQUEST_TIMEOUT):
-        self.server_addr = tuple(server_addr)
+        self.endpoints = normalize_endpoints(server_addr)
+        self.server_addr = self.endpoints[0]
         self._retries = retries
         self._retry_delay = retry_delay
         self._request_timeout = request_timeout
+        #: Highest fencing epoch observed in any reply (0 = un-journaled
+        #: server, which never stamps one).
+        self.last_epoch = 0
+        #: Consecutive failed exchange attempts; RESET TO ZERO on every
+        #: healthy request/reply, so transient resets spread over a long
+        #: run can never exhaust the budget the way a cumulative counter
+        #: would (the PR 13 ServiceFeed dial-budget fix, applied here).
+        self._consecutive_failures = 0
         self._sock = self._connect()
 
     def _connect(self):
@@ -743,36 +1326,131 @@ class Client(MessageSocket):
         fault.from_env().delay_socket()
         last = None
         for attempt in range(self._retries + 1):
-            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            try:
-                sock.connect(self.server_addr)
-                return sock
-            except OSError as e:  # reference retry-reconnect 227-240
-                last = e
-                sock.close()
-                if attempt < self._retries:
-                    time.sleep(self._retry_delay * (attempt + 1))
+            # Walk the endpoint list in order each attempt: the primary
+            # first, then the standbys at their pinned ports — after a
+            # failover only the promoted standby accepts, so the walk
+            # lands there.
+            for ep in self.endpoints:
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                try:
+                    sock.connect(ep)
+                    self.server_addr = ep
+                    return sock
+                except OSError as e:  # reference retry-reconnect 227-240
+                    last = e
+                    sock.close()
+            if attempt < self._retries:
+                time.sleep(self._retry_delay * (attempt + 1))
         raise ConnectionError(
-            "Unable to reach reservation server at {}:{}: {}".format(
-                self.server_addr[0], self.server_addr[1], last
+            "Unable to reach reservation server at {}: {}".format(
+                ", ".join("{}:{}".format(h, p) for h, p in self.endpoints),
+                last
             )
         )
+
+    def _redial(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = self._connect()
+
+    def _demote_endpoint(self, ep):
+        """Move a known-zombie endpoint to the END of the dial order.  A
+        fenced zombie still ACCEPTS connections — without demotion the
+        primary-first redial walk would keep landing on it and burn the
+        whole redial budget answering its superseded-ERRs."""
+        ep = tuple(ep)
+        rest = [e for e in self.endpoints if tuple(e) != ep]
+        if rest:
+            self.endpoints = rest + [ep]
+
+    #: Wire key carrying the server's fencing epoch in replies.  The
+    #: dispatcher protocol overrides this ("fence_epoch") because its TASK
+    #: replies already use "epoch" for the job's DATA epoch — reading a
+    #: job epoch as a fencing epoch would fence healthy dispatchers.
+    _fence_epoch_key = "epoch"
+
+    def _check_reply_epoch(self, resp):
+        """Track the highest fencing epoch seen; a reply stamped with a
+        LOWER one came from a fenced zombie — refuse it (raise so the
+        caller redials toward the successor)."""
+        if not isinstance(resp, dict):
+            return resp
+        if resp.get("superseded"):
+            # A fenced zombie answered: its successor owns the ledger.
+            # This is a routing failure, NOT a liveness fence — raising a
+            # connection error makes idempotent requests redial across the
+            # endpoint list toward the promoted coordinator (the zombie
+            # endpoint demoted so the walk reaches the successor first).
+            self._demote_endpoint(self.server_addr)
+            raise ConnectionError(
+                "coordinator at {}:{} superseded by epoch {}".format(
+                    self.server_addr[0], self.server_addr[1],
+                    resp["superseded"]))
+        epoch = resp.get(self._fence_epoch_key)
+        if not isinstance(epoch, int):
+            return resp
+        if epoch < self.last_epoch:
+            self._demote_endpoint(self.server_addr)
+            raise ConnectionError(
+                "reply from superseded coordinator (epoch {} < {})".format(
+                    epoch, self.last_epoch))
+        self.last_epoch = epoch
+        return resp
 
     def _request(self, msg, timeout=_UNSET):
         if timeout is _UNSET:
             timeout = self._request_timeout
-        self._sock.settimeout(timeout)
-        try:
-            self.send(self._sock, msg)
-            return self.receive(self._sock)
-        except socket.timeout:
-            raise TimeoutError(
-                "reservation server at {}:{} did not answer a {} request "
-                "within {}s — the driver process may have died; check the "
-                "driver logs".format(self.server_addr[0], self.server_addr[1],
-                                     msg.get("type"), timeout))
-        finally:
-            self._sock.settimeout(None)
+        redials = 1 + len(self.endpoints) if len(self.endpoints) > 1 else 0
+        while True:
+            self._sock.settimeout(timeout)
+            try:
+                self.send(self._sock, msg)
+                resp = self._check_reply_epoch(self.receive(self._sock))
+                self._consecutive_failures = 0
+                return resp
+            except socket.timeout:
+                # A stalled (not dead) coordinator — SIGSTOP, GC pause,
+                # partition — still completes TCP handshakes in the kernel,
+                # so plain redialing would land right back on it.  Demote
+                # the unresponsive endpoint and retry idempotent requests
+                # toward the standbys, exactly like a reset.
+                self._consecutive_failures += 1
+                if redials > 0 and msg.get("type") in _IDEMPOTENT_TYPES:
+                    redials -= 1
+                    self._demote_endpoint(self.server_addr)
+                    logger.warning(
+                        "reservation request %s timed out after %ss; "
+                        "redialing across %d endpoint(s)", msg.get("type"),
+                        timeout, len(self.endpoints))
+                    self._redial()
+                    continue
+                raise TimeoutError(
+                    "reservation server at {}:{} did not answer a {} request "
+                    "within {}s — the driver process may have died; check the "
+                    "driver logs".format(self.server_addr[0],
+                                         self.server_addr[1],
+                                         msg.get("type"), timeout))
+            except (ConnectionError, EOFError, OSError) as e:
+                # Reset mid-exchange: the primary died (or a zombie
+                # answered).  For idempotent types, redial across the
+                # endpoint list and re-send — a promoted standby at a
+                # pinned port answers the retry.
+                self._consecutive_failures += 1
+                if redials <= 0 or msg.get("type") not in _IDEMPOTENT_TYPES:
+                    raise
+                redials -= 1
+                logger.warning(
+                    "reservation request %s reset (%s); redialing across "
+                    "%d endpoint(s)", msg.get("type"), e,
+                    len(self.endpoints))
+                self._redial()
+            finally:
+                try:
+                    self._sock.settimeout(None)
+                except OSError:
+                    pass
 
     def register(self, meta):
         """Register this node's metadata (reference ``reservation.py:251-254``)."""
@@ -865,11 +1543,35 @@ class Client(MessageSocket):
                     resp = self.receive(self._sock)
                 except socket.timeout:
                     continue  # roster still assembling; keep waiting
+                except (EOFError, OSError):
+                    # Parked connection reset: the coordinator died.  An
+                    # AWAIT is a pure read, so re-parking on a fresh
+                    # connection (the promoted standby, via the endpoint
+                    # list) is safe — NOT the same as re-sending on a LIVE
+                    # connection, which would double-park the fd.
+                    if len(self.endpoints) <= 1:
+                        raise
+                    logger.warning("AWAIT connection reset; redialing the "
+                                   "coordinator endpoint list")
+                    self._redial()
+                    self.send(self._sock, msg)
+                    continue
+                self._check_reply_epoch(resp)
                 data = resp.get("data")
                 if data is not None:
                     return data
         finally:
-            self._sock.settimeout(None)
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass
+
+    def state(self):
+        """Full coordinator-state probe (``STATE``): generation, roster
+        counts, dead/bye/released sets, aggregated node metrics, and the
+        HA block (epoch, journal footprint) — what the CI chaos gate
+        asserts exact totals against after a failover."""
+        return self._request({"type": "STATE"})
 
     def request_stop(self):
         """Signal STOP (streaming termination / early stop; reference 269-272)."""
@@ -940,6 +1642,28 @@ class KnobCoordinator(object):
                 if target is None:
                     merged.update(knobs)
             return merged
+
+    def to_state(self):
+        """JSON-serializable full state (push history, per-executor drain
+        positions, sequence counter) for coordinator snapshots — a
+        recovered/promoted coordinator resumes exactly-once fan-out where
+        the dead one stopped, instead of replaying or losing pushes."""
+        with self._lock:
+            return {"seq": self._seq,
+                    "pushes": [[s, dict(k), t] for s, k, t in self._pushes],
+                    "seen": dict(self._seen),
+                    "history": self._history}
+
+    @classmethod
+    def from_state(cls, state):
+        """Rebuild from :meth:`to_state` output."""
+        kc = cls(history=state.get("history", 256))
+        kc._seq = int(state.get("seq", 0))
+        kc._pushes = [(int(s), dict(k), t)
+                      for s, k, t in (state.get("pushes") or [])]
+        kc._seen = {str(ex): int(seq)
+                    for ex, seq in (state.get("seen") or {}).items()}
+        return kc
 
 
 class HeartbeatSender(object):

@@ -234,6 +234,8 @@ class RuleEngine(object):
         self._mfu_baseline = {}      # node -> best mfu_pct seen this run
         self._nonfinite_seen = {}    # node -> last reported tally total
         self._beat_ages = None       # per-evaluate liveness input
+        self._coordinator = None     # per-evaluate HA status input
+        self._last_epoch = None      # fencing epoch seen at last evaluate
         self.rules = (
             ("straggler_step_time", self._rule_straggler_step_time),
             ("straggler_dispatch_gap", self._rule_straggler_dispatch_gap),
@@ -245,6 +247,7 @@ class RuleEngine(object):
             ("cache_thrash", self._rule_cache_thrash),
             ("latency_slo_burn", self._rule_latency_slo_burn),
             ("heartbeat_miss", self._rule_heartbeat_miss),
+            ("coordinator_takeover", self._rule_coordinator_takeover),
         )
 
     def active_rules(self):
@@ -257,7 +260,7 @@ class RuleEngine(object):
 
     # -- evaluation --------------------------------------------------------
 
-    def evaluate(self, series, now=None, beat_ages=None):
+    def evaluate(self, series, now=None, beat_ages=None, coordinator=None):
         """Run every rule over the trailing window of ``series`` (the
         ``SampleRing.series()`` shape: ``{node: [(ts, counters), ...]}``).
         Returns a list of alert dicts, most severe first within a tick.
@@ -268,6 +271,10 @@ class RuleEngine(object):
         the heartbeat-miss rule judges real beat silence — covering nodes
         whose beats carry no metrics — instead of sample-series age (the
         replay fallback, where only the journal's timestamps exist).
+
+        ``coordinator`` (``reservation.Server.ha_status()``): when given,
+        the coordinator-takeover rule watches the fencing epoch and fires
+        a crit alert the tick it advances (a standby promoted).
         """
         now = time.time() if now is None else now
         w = self.config["window_secs"]
@@ -277,6 +284,7 @@ class RuleEngine(object):
             if in_win:
                 window[str(node)] = in_win
         self._beat_ages = beat_ages
+        self._coordinator = coordinator
         alerts = []
         for name, rule in self.rules:
             try:
@@ -577,6 +585,35 @@ class RuleEngine(object):
                                 self.heartbeat_interval * 3)))
         return alerts
 
+    def _rule_coordinator_takeover(self, window, now):
+        """Fencing-epoch watch: the epoch advances exactly once per
+        coordinator incarnation (``standby.advance_epoch``), so an
+        increase mid-run means a warm standby promoted — the primary
+        died or stalled past the takeover threshold.  The first epoch
+        observed is the baseline (the run's own claim is not a
+        takeover)."""
+        ha = self._coordinator
+        if not ha:
+            return []
+        epoch = ha.get("epoch")
+        if not epoch:
+            return []
+        if self._last_epoch is None:
+            self._last_epoch = epoch
+            return []
+        if epoch <= self._last_epoch:
+            return []
+        previous, self._last_epoch = self._last_epoch, epoch
+        return [self._alert(
+            "coordinator_takeover", now, severity="crit", value=epoch,
+            threshold=previous,
+            grace_remaining_secs=ha.get("grace_remaining_secs"),
+            recovered_nodes=ha.get("recovered_nodes"),
+            message="coordinator fencing epoch advanced {} -> {}: a warm "
+                    "standby took over; liveness fencing suppressed for "
+                    "{}s".format(previous, epoch,
+                                 ha.get("grace_remaining_secs")))]
+
 
 class Watchtower(object):
     """Live driver-side streaming evaluator over the observatory's ring.
@@ -606,17 +643,22 @@ class Watchtower(object):
         heartbeat silence (``reservation.Server.beat_ages``) — the
         heartbeat-miss rule then judges real beats instead of
         metrics-sample age.
+      coordinator_fn: optional zero-arg callable returning the
+        coordinator's HA status (``reservation.Server.ha_status``) — arms
+        the coordinator-takeover rule (crit on fencing-epoch advance).
       clock: injectable time source (tests).
     """
 
     def __init__(self, ring, snapshot_fn=None, heartbeat_interval=None,
                  config=None, journal_path=None, on_alert=None,
-                 on_suspect=None, beat_ages_fn=None, clock=time.time):
+                 on_suspect=None, beat_ages_fn=None, coordinator_fn=None,
+                 clock=time.time):
         self.engine = RuleEngine(config, heartbeat_interval)
         cfg = self.engine.config
         self.ring = ring
         self._snapshot_fn = snapshot_fn
         self._beat_ages_fn = beat_ages_fn
+        self._coordinator_fn = coordinator_fn
         self._on_alert = on_alert
         self._on_suspect = on_suspect
         self._clock = clock
@@ -690,8 +732,15 @@ class Watchtower(object):
                 ages = self._beat_ages_fn()
             except Exception:
                 ages = None
+        ha = None
+        if self._coordinator_fn is not None:
+            try:
+                ha = self._coordinator_fn()
+            except Exception:
+                ha = None
         admitted = []
-        for alert in self.engine.evaluate(series, now, beat_ages=ages):
+        for alert in self.engine.evaluate(series, now, beat_ages=ages,
+                                          coordinator=ha):
             if not self._dedup.admit(alert):
                 continue
             admitted.append(alert)

@@ -1,0 +1,419 @@
+"""Coordinator-HA tests: journaled reservation server, fencing epochs,
+warm-standby promotion, endpoint-list client failover.
+
+The journal/snapshot round-trip tests drive ``Server._handle_message``
+directly with a fake socket — no listener threads, no real sockets — so
+they exercise exactly the ledger paths a failover replays.  The failover
+tests at the bottom use real sockets on loopback with pinned ports.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import fault, reservation, standby, watchtower
+
+
+class FakeSock(object):
+    """Collects ``sendall`` payloads; replies decoded via :meth:`replies`."""
+
+    def __init__(self):
+        self.buf = b""
+
+    def sendall(self, data):
+        self.buf += data
+
+    def replies(self):
+        out, buf = [], self.buf
+        while buf:
+            (n,) = reservation._HEADER.unpack(buf[:4])
+            out.append(json.loads(buf[4:4 + n].decode("utf-8")))
+            buf = buf[4 + n:]
+        return out
+
+    def last(self):
+        return self.replies()[-1]
+
+
+def _journaled_server(tmp_path, count=3, heartbeat_interval=0.2, **kw):
+    server = reservation.Server(
+        count, heartbeat_interval=heartbeat_interval, heartbeat_misses=1,
+        journal_dir=str(tmp_path), snapshot_every=10000, **kw)
+    # What start() does before listening, minus the socket.
+    server.fencing_epoch = standby.advance_epoch(str(tmp_path))
+    server._recover()
+    return server
+
+
+def _handle(server, msg):
+    sock = FakeSock()
+    server._handle_message(sock, msg, {})
+    return sock.last()
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# -- endpoint normalization ------------------------------------------------
+
+
+def test_normalize_endpoints_shapes():
+    norm = reservation.normalize_endpoints
+    assert norm("h:1234") == [("h", 1234)]
+    assert norm(("h", 1234)) == [("h", 1234)]
+    assert norm(["h", "1234"]) == [("h", 1234)]
+    assert norm([("a", 1), ("b", 2)]) == [("a", 1), ("b", 2)]
+    assert norm(["a:1", "b:2"]) == [("a", 1), ("b", 2)]
+    assert norm([["a", 1], "b:2"]) == [("a", 1), ("b", 2)]
+    with pytest.raises(ValueError):
+        norm([])
+
+
+# -- knob coordinator state round-trip -------------------------------------
+
+
+def test_knob_coordinator_state_round_trip():
+    kc = reservation.KnobCoordinator()
+    kc.push({"prefetch": 4})
+    kc.push({"prefetch": 8, "readers": 2})
+    kc.push({"only": "one"}, executor_id="7")
+    assert kc.poll("3") == {"prefetch": 8, "readers": 2}  # drains node 3
+
+    clone = reservation.KnobCoordinator.from_state(kc.to_state())
+    # Drain positions survive: node 3 sees nothing new, node 7 its
+    # targeted push merged with the broadcasts, exactly like the original.
+    assert clone.poll("3") is None
+    assert clone.poll("7") == {"prefetch": 8, "readers": 2, "only": "one"}
+    assert clone.current() == kc.current() == {"prefetch": 8, "readers": 2}
+    # New pushes continue the sequence instead of reusing spent numbers.
+    assert clone.push({"prefetch": 16}) == kc.to_state()["seq"] + 1
+
+
+# -- journal + snapshot round-trip (no sockets) ----------------------------
+
+
+def _populate(server):
+    """Registrations, a fence + slot release + replacement, a BYE with
+    final metrics, a knob push, and a STOP — one of every journaled
+    mutation."""
+    for i in range(3):
+        meta = {"executor_id": i, "host": "h%d" % i, "job_name": "worker",
+                "task_index": i, "port": 2222}
+        assert _handle(server, {"type": "REG", "data": meta})["type"] == "OK"
+    # Fence executor 2 via the real liveness path (stale beat, misses=1).
+    last, meta = server._beats[2]
+    server._beats[2] = (last - 60.0, meta)
+    server._check_liveness()
+    assert 2 in server._dead
+    assert server.release_slot(2) is not None
+    # Replacement claims the freed slot under a fresh identity.
+    assert _handle(server, {"type": "REG", "data": {
+        "executor_id": 9, "host": "h9", "job_name": "worker",
+        "task_index": 2, "port": 2222}})["type"] == "OK"
+    assert server.reservations.generation == 1
+    # Node 1 finishes cleanly; its totals ride the BYE record.
+    assert _handle(server, {"type": "BYE", "data": {
+        "executor_id": 1, "reason": "done",
+        "metrics": {"items": 120, "steps": 30}}})["type"] == "OK"
+    server.push_knobs({"prefetch": 8})
+    assert _handle(server, {"type": "STOP"})["type"] == "OK"
+
+
+def test_snapshot_and_journal_round_trip(tmp_path):
+    s1 = _journaled_server(tmp_path)
+    _populate(s1)
+
+    s2 = _journaled_server(tmp_path)
+    assert s2.fencing_epoch == s1.fencing_epoch + 1
+    assert s2.recoveries == 1
+    assert s2.recovered_nodes == 3
+    res = s2.reservations
+    assert res.done() and res.generation == 1
+    assert {m["executor_id"] for m in res.get()} == {0, 9, 1}
+    assert "2" in {str(x) for x in s2._released_ids}
+    assert set(s2._dead) == {2} or set(s2._dead) == {"2"}
+    assert s2._byes in ({1: "done"}, {"1": "done"})
+    assert s2._node_metrics[1 if 1 in s2._node_metrics else "1"] == {
+        "items": 120, "steps": 30}
+    assert s2.done is True
+    assert s2.knob_coordinator.current() == {"prefetch": 8}
+    # A node that never drained the push still gets it from the successor.
+    assert s2.knob_coordinator.poll("0") == {"prefetch": 8}
+    s2.stop()
+
+    # The predecessor is now a zombie: its next journal append observes the
+    # newer on-disk epoch and self-fences; every request answers a
+    # STRUCTURED superseded ERR (clients redial on it, not terminate).
+    s1._journal({"t": "stop"})
+    assert s1.superseded_by == s2.fencing_epoch
+    err = _handle(s1, {"type": "HBEAT", "data": {"executor_id": 0}})
+    assert err["type"] == "ERR"
+    assert err["superseded"] == s2.fencing_epoch
+    s1.stop()
+
+
+def test_journal_torn_tail_tolerated(tmp_path):
+    s1 = _journaled_server(tmp_path)
+    _populate(s1)
+    # SIGKILL mid-write: the tail record is torn.  Replay must keep every
+    # complete record before it and ignore the tail.
+    seg = s1._segment_path("journal", s1._journal_seq)
+    with open(seg, "a") as f:
+        f.write('{"t": "reg", "meta": {"executor')
+    s2 = _journaled_server(tmp_path)
+    assert s2.reservations.done()
+    assert s2.reservations.generation == 1
+    assert s2.done is True
+    s1.stop()
+    s2.stop()
+
+
+def test_snapshot_compaction_prunes_old_generations(tmp_path):
+    s1 = reservation.Server(
+        2, journal_dir=str(tmp_path), snapshot_every=2, journal_keep=2)
+    s1.fencing_epoch = standby.advance_epoch(str(tmp_path))
+    s1._recover()
+    for i in range(12):
+        s1._journal({"t": "reg", "meta": {"node": i}, "generation": 0})
+    snaps = [n for n in os.listdir(str(tmp_path))
+             if n.startswith("snapshot-")]
+    assert 0 < len(snaps) <= 2
+    s1.stop()
+
+
+def test_recovery_grace_suppresses_fencing_then_expires(tmp_path):
+    s1 = _journaled_server(tmp_path, count=1, heartbeat_interval=0.2)
+    assert _handle(s1, {"type": "REG", "data": {
+        "executor_id": 0, "host": "h", "job_name": "worker",
+        "task_index": 0}})["type"] == "OK"
+
+    s2 = _journaled_server(tmp_path, count=1, heartbeat_interval=0.2,
+                           takeover_grace=30.0)
+    # The recovered roster's beats are re-armed at promotion time and the
+    # grace window holds fencing shut even for a stale beat.
+    assert 0 in s2._beats
+    assert s2.ha_status()["grace_remaining_secs"] > 0
+    last, meta = s2._beats[0]
+    s2._beats[0] = (last - 60.0, meta)
+    s2._check_liveness()
+    assert s2._dead == {}
+    # Grace over: the same silence now fences.
+    s2._fence_grace_until = 0.0
+    s2._check_liveness()
+    assert 0 in s2._dead
+    s1.stop()
+    s2.stop()
+
+
+def test_fresh_server_has_no_grace(tmp_path):
+    server = _journaled_server(tmp_path, count=1)
+    assert server.recoveries == 0
+    assert server.ha_status()["grace_remaining_secs"] == 0
+    server.stop()
+
+
+# -- live failover over real sockets ---------------------------------------
+
+
+def test_client_fails_over_past_zombie_to_promoted_standby(tmp_path):
+    p1, p2 = _free_port(), _free_port()
+    s1 = reservation.Server(1, heartbeat_interval=5.0, host="127.0.0.1",
+                            port=p1, journal_dir=str(tmp_path))
+    s1.start()
+    client = reservation.Client([("127.0.0.1", p1), ("127.0.0.1", p2)],
+                                retries=1, retry_delay=0.1)
+    try:
+        client.register({"executor_id": 0, "host": "127.0.0.1",
+                         "job_name": "worker", "task_index": 0})
+        assert client.heartbeat(0)
+        assert client.last_epoch == 1
+        assert client._consecutive_failures == 0
+
+        # Promote a successor while the primary is still ALIVE (a zombie,
+        # not a corpse — the harder case: it still accepts connections).
+        s2 = reservation.Server(1, heartbeat_interval=5.0, host="127.0.0.1",
+                                port=p2, journal_dir=str(tmp_path),
+                                takeover_grace=10.0)
+        s2.start()
+        try:
+            # The beat hits the zombie first, gets the superseded ERR,
+            # demotes that endpoint, and lands on the successor — all
+            # inside one heartbeat() call (HBEAT is idempotent).
+            assert client.heartbeat(0)
+            assert client.last_epoch == 2
+            assert client._consecutive_failures == 0  # reset on success
+            assert client.endpoints[0] == ("127.0.0.1", p2)
+
+            st = client.state()
+            assert st["ha"]["epoch"] == 2
+            assert st["registered"] == 1  # roster recovered from the journal
+            assert st["dead"] == {}      # grace held: nobody false-fenced
+        finally:
+            s2.stop()
+    finally:
+        client.close()
+        s1.stop()
+
+
+def test_heartbeat_sender_survives_primary_death(tmp_path):
+    p1, p2 = _free_port(), _free_port()
+    endpoints = [("127.0.0.1", p1), ("127.0.0.1", p2)]
+    s1 = reservation.Server(1, heartbeat_interval=0.1, heartbeat_misses=50,
+                            host="127.0.0.1", port=p1,
+                            journal_dir=str(tmp_path))
+    s1.start()
+    reg = reservation.Client(endpoints, retries=1, retry_delay=0.1)
+    reg.register({"executor_id": 0, "host": "127.0.0.1",
+                  "job_name": "worker", "task_index": 0})
+    reg.close()
+    sender = reservation.HeartbeatSender(endpoints, 0, 0.1).start()
+    try:
+        time.sleep(0.4)
+        s1.stop()  # the primary dies outright
+        s2 = reservation.Server(1, heartbeat_interval=0.1,
+                                heartbeat_misses=50, host="127.0.0.1",
+                                port=p2, journal_dir=str(tmp_path),
+                                takeover_grace=10.0)
+        s2.start()
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline and 0 not in s2._beats:
+                time.sleep(0.05)
+            assert 0 in s2._beats  # beats re-homed to the successor
+            assert not sender.fenced
+        finally:
+            sender.stop(goodbye=True, reason="done")
+            assert s2._byes.get(0) == "done" or s2._byes.get("0") == "done"
+            s2.stop()
+    finally:
+        sender._stop.set()
+
+
+def test_await_reservations_survives_failover(tmp_path):
+    p1, p2 = _free_port(), _free_port()
+    endpoints = [("127.0.0.1", p1), ("127.0.0.1", p2)]
+    s1 = reservation.Server(2, host="127.0.0.1", port=p1,
+                            journal_dir=str(tmp_path))
+    s1.start()
+    waiter = reservation.Client(endpoints, retries=2, retry_delay=0.1)
+    waiter.register({"executor_id": 0, "host": "127.0.0.1",
+                     "job_name": "worker", "task_index": 0})
+    result = {}
+
+    def _wait():
+        result["info"] = waiter.await_reservations(timeout=15)
+
+    t = threading.Thread(target=_wait, daemon=True)
+    t.start()
+    time.sleep(0.3)  # the AWAIT is parked on the primary
+    s1.stop()
+    s2 = reservation.Server(2, host="127.0.0.1", port=p2,
+                            journal_dir=str(tmp_path))
+    s2.start()
+    try:
+        # The second registration completes the roster ON THE SUCCESSOR;
+        # the parked waiter re-parks there and gets the full answer.
+        other = reservation.Client(endpoints, retries=2, retry_delay=0.1)
+        other.register({"executor_id": 1, "host": "127.0.0.1",
+                        "job_name": "worker", "task_index": 1})
+        other.close()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert {m["executor_id"] for m in result["info"]} == {0, 1}
+    finally:
+        waiter.close()
+        s2.stop()
+
+
+def test_warm_standby_promotes_on_beacon_silence(tmp_path):
+    port = _free_port()
+    jdir = str(tmp_path)
+    # No beacon yet: a standby must NOT promote over an unclaimed dir.
+    watcher = standby.WarmStandby(
+        lambda: reservation.Server(1, host="127.0.0.1", port=port,
+                                   journal_dir=jdir),
+        jdir, takeover_after=0.3, poll_interval=0.05, name="reservation")
+    watcher.start()
+    assert not watcher.wait_promoted(timeout=0.6)
+    # A primary stamps the beacon once, then dies silently.
+    standby.write_beacon(jdir, 1, host="127.0.0.1", port=12345,
+                         role="reservation")
+    assert watcher.wait_promoted(timeout=5.0)
+    try:
+        assert watcher.server.fencing_epoch >= 1
+        assert watcher.address[1] == port
+        # The promoted coordinator stamps the beacon itself now.
+        client = reservation.Client(watcher.address, retries=1,
+                                    retry_delay=0.1)
+        st = client.state()
+        assert st["ha"]["epoch"] == watcher.server.fencing_epoch
+        client.close()
+    finally:
+        watcher.stop()
+        watcher.server.stop()
+
+
+# -- fault hook ------------------------------------------------------------
+
+
+def test_fault_arm_coordinator_kill(monkeypatch):
+    killed = threading.Event()
+    monkeypatch.setattr(fault.FaultInjector, "_kill_self",
+                        staticmethod(killed.set))
+    inj = fault.FaultInjector({"kill_coordinator_after_secs": 0.05})
+    inj.arm_coordinator_kill("reservation")
+    assert killed.wait(timeout=2.0)
+    assert "kill_coordinator_after_secs" not in inj.spec  # armed once
+
+
+def test_fault_coordinator_kill_role_targeting(monkeypatch):
+    killed = threading.Event()
+    monkeypatch.setattr(fault.FaultInjector, "_kill_self",
+                        staticmethod(killed.set))
+    inj = fault.FaultInjector({"kill_coordinator_after_secs": 0.05,
+                               "coordinator_role": "dispatcher"})
+    inj.arm_coordinator_kill("reservation")  # wrong role: stays armed
+    assert not killed.wait(timeout=0.3)
+    assert "kill_coordinator_after_secs" in inj.spec
+    inj.arm_coordinator_kill("dispatcher")
+    assert killed.wait(timeout=2.0)
+
+
+def test_null_injector_arm_coordinator_kill_is_noop():
+    fault.FaultInjector.from_env({}).arm_coordinator_kill("reservation")
+
+
+# -- watchtower takeover rule ----------------------------------------------
+
+
+def test_watchtower_coordinator_takeover_rule():
+    eng = watchtower.RuleEngine()
+    # First observation is the baseline — the run's own epoch claim.
+    assert eng.evaluate({}, now=100.0, coordinator={"epoch": 3}) == []
+    # Steady state: no alert.
+    assert eng.evaluate({}, now=101.0, coordinator={"epoch": 3}) == []
+    # Epoch advance: a standby promoted — crit.
+    alerts = eng.evaluate({}, now=102.0, coordinator={
+        "epoch": 4, "grace_remaining_secs": 1.5, "recovered_nodes": 2})
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["rule"] == "coordinator_takeover"
+    assert a["severity"] == "crit"
+    assert a["value"] == 4 and a["threshold"] == 3
+    # No re-alert while the epoch holds; a later advance alerts again.
+    assert eng.evaluate({}, now=103.0, coordinator={"epoch": 4}) == []
+    assert eng.evaluate({}, now=104.0, coordinator={"epoch": 5})[0][
+        "value"] == 5
+    # Un-journaled coordinators (epoch 0) never alert.
+    fresh = watchtower.RuleEngine()
+    assert fresh.evaluate({}, now=100.0, coordinator={"epoch": 0}) == []
+    assert fresh.evaluate({}, now=101.0, coordinator=None) == []

@@ -43,6 +43,7 @@ import argparse
 import calendar
 import json
 import os
+import signal
 import subprocess
 import sys
 import tempfile
@@ -247,6 +248,17 @@ def _run_synthetic_leg(trainer, batch, mask, k, steps, stats_path, chief,
         roof["roofline_frac"] = round(ideal / avg_step, 4)
     if roof:
         stats["roofline"] = roof
+    # Megastep stamp (same block fit_feed writes): synthetic legs scan over
+    # ONE device-resident batch, so there is no group assembly and nothing
+    # to donate back to the feed — but the K and the donation flags still
+    # say which engine produced the number.
+    stats["megastep"] = {
+        "steps_per_call": k,
+        "steps_per_call_last": k,
+        "group_assembly": "resident" if k > 1 else None,
+        "donate_state": bool(trainer._donate),
+        "donate_batches": False,
+    }
     if extra:
         stats.update(extra)
     if chief:
@@ -1226,10 +1238,11 @@ def _leg_subprocess(leg, out_path):
 
 # Per-attempt probe transcript for the round artifact: every probe_device
 # attempt this process ran (the up-front probe, per-leg health re-probes,
-# recoveries) appends {attempt, elapsed, error} here, and main() publishes
-# it as `probe_history` — so a degraded round's JSON shows WHEN the tunnel
-# was tried, how long each attempt hung, and what it said, instead of one
-# flattened error string.
+# recoveries) appends {attempt, elapsed, error, platform, device_count}
+# here, and main() publishes it as `probe_history` — so a degraded round's
+# JSON shows WHEN the tunnel was tried, how long each attempt hung, and
+# what it saw (the diagnostic line: platform / device count / elapsed),
+# instead of one flattened error string.
 PROBE_HISTORY = []
 
 # Probe budget: a remotely-attached TPU's first jax init has been observed
@@ -1237,6 +1250,29 @@ PROBE_HISTORY = []
 # "timed out" probes against a device that was actually reachable — and
 # replayed the whole round.  Longer default + env override for slower links.
 PROBE_TIMEOUT_SECS = float(os.environ.get("TFOS_BENCH_PROBE_TIMEOUT", 240))
+
+
+def _probe_subprocess(code, timeout):
+    """Run the probe child with a HARD timeout: the child gets its own
+    process group and the WHOLE group is SIGKILLed on expiry.
+    ``subprocess.run``'s timeout only kills the direct child — a jax init
+    wedged in native code can leave helper grandchildren holding the pipe
+    open, so the r05 probes were observed to hang well past their nominal
+    deadline.  Returns ``(returncode, stdout, stderr)`` or raises
+    ``subprocess.TimeoutExpired``."""
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, start_new_session=True)
+    try:
+        out, errout = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (OSError, ProcessLookupError):  # already gone / no perms
+            proc.kill()
+        proc.wait()
+        raise
+    return proc.returncode, out, errout
 
 
 def probe_device(timeout=None, attempts=3, retry_sleep=60):
@@ -1248,34 +1284,47 @@ def probe_device(timeout=None, attempts=3, retry_sleep=60):
     (observed: reachable at 04:57, gone by 05:24, same day), so a single
     failed probe must not zero the round's device numbers: retry with
     EXPONENTIAL backoff (``retry_sleep``, doubling per attempt — a flap
-    needs a growing pause, not a fixed one) before giving up.  Returns
+    needs a growing pause, not a fixed one) before giving up.  The child is
+    killed HARD at the deadline (whole process group — see
+    ``_probe_subprocess``), and every attempt records one diagnostic line
+    (platform, device count, elapsed) in ``PROBE_HISTORY``.  Returns
     ``(device_kind, None)`` or ``(None, error_string)``.
     """
     if timeout is None:
         timeout = PROBE_TIMEOUT_SECS
-    code = "import jax; print(jax.devices()[0].device_kind)"
+    code = ("import json, jax; ds = jax.devices(); "
+            "print(json.dumps({'kind': ds[0].device_kind, "
+            "'platform': ds[0].platform, 'device_count': len(ds)}))")
     err = None
     for attempt in range(attempts):
         if attempt:
             time.sleep(retry_sleep * (2 ** (attempt - 1)))
         t0 = time.time()
+        entry = {"attempt": attempt + 1}
         try:
-            proc = subprocess.run([sys.executable, "-c", code],
-                                  timeout=timeout, capture_output=True,
-                                  text=True)
-            if proc.returncode == 0 and proc.stdout.strip():
-                PROBE_HISTORY.append({"attempt": attempt + 1,
-                                      "elapsed": round(time.time() - t0, 1),
-                                      "error": None})
-                return proc.stdout.strip().splitlines()[-1], None
-            err = "device probe rc={}: {}".format(
-                proc.returncode, proc.stderr[-300:])
+            rc, out, errout = _probe_subprocess(code, timeout)
+            if rc == 0 and out.strip():
+                line = out.strip().splitlines()[-1]
+                try:
+                    diag = json.loads(line)
+                except ValueError:  # older/odd child output: raw kind only
+                    diag = {"kind": line}
+                elapsed = round(time.time() - t0, 1)
+                entry.update(elapsed=elapsed, error=None,
+                             platform=diag.get("platform"),
+                             device_count=diag.get("device_count"))
+                PROBE_HISTORY.append(entry)
+                print("bench: device probe ok: platform={} devices={} "
+                      "kind={} elapsed={}s".format(
+                          diag.get("platform"), diag.get("device_count"),
+                          diag.get("kind"), elapsed), file=sys.stderr)
+                return diag.get("kind"), None
+            err = "device probe rc={}: {}".format(rc, (errout or "")[-300:])
         except subprocess.TimeoutExpired:
             err = ("device probe timed out after {}s (accelerator/tunnel "
-                   "unreachable)".format(timeout))
-        PROBE_HISTORY.append({"attempt": attempt + 1,
-                              "elapsed": round(time.time() - t0, 1),
-                              "error": err})
+                   "unreachable; probe process group killed)".format(timeout))
+        entry.update(elapsed=round(time.time() - t0, 1), error=err)
+        PROBE_HISTORY.append(entry)
         print("bench: {} (attempt {}/{})".format(err, attempt + 1, attempts),
               file=sys.stderr)
     return None, err
@@ -1560,6 +1609,21 @@ def main():
             ((lm or {}).get("roofline") or {}).get("roofline_frac"),
         "transformer_lm_compile_secs":
             ((lm or {}).get("roofline") or {}).get("compile_secs"),
+        # megastep stamps: which step-loop engine produced each model leg's
+        # number — K steps per dispatch, how K-groups were assembled
+        # (device-stack vs host-stack vs one resident batch), and whether
+        # state / batch stacks were donated.  None when a leg replayed
+        # from pre-megastep evidence.
+        "resnet50_steps_per_call":
+            ((resnet or {}).get("megastep") or {}).get("steps_per_call"),
+        "transformer_lm_steps_per_call":
+            ((lm or {}).get("megastep") or {}).get("steps_per_call"),
+        "mnist_steps_per_call":
+            ((mnist or {}).get("megastep") or {}).get("steps_per_call"),
+        "mnist_group_assembly":
+            ((mnist or {}).get("megastep") or {}).get("group_assembly"),
+        "mnist_donate_batches":
+            ((mnist or {}).get("megastep") or {}).get("donate_batches"),
     }
     if feedplane:
         out["feed_plane_images_per_sec"] = round(
@@ -1709,6 +1773,10 @@ def main():
             "infeed_put_us_avg": round(ov.get("infeed_put_us", 0) / nb, 1),
             "infeed_assembly_us_avg": round(
                 ov.get("infeed_assembly_us", 0) / nb, 1),
+            # device-side K-stack dispatch cost per dispatch (0 under
+            # host-stack assembly or K=1)
+            "group_assemble_us_avg": round(
+                ov.get("train_group_assemble_us", 0) / disp, 1),
         }
     # per-leg provenance: every leg's number is either fresh from THIS run,
     # replayed from earlier evidence, or absent

@@ -70,7 +70,14 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # SIGKILLed mid-run, the warm standby promotes off the journal under a
 # bumped fencing epoch, the zombie's writes are rejected by epoch, nodes
 # re-home via endpoint-list redial with exact item totals and no healthy
-# node false-fenced during the takeover grace window
+# node false-fenced during the takeover grace window, and prove the
+# megastep engine amortizes: a 2-node cluster under
+# TFOS_TRANSFER_GUARD=disallow runs guard-clean K=4 grouped dispatches
+# with device-side stack assembly and donated stacks, a live
+# train_steps_per_call=8 push through node.apply_knobs lands exactly on a
+# group boundary (whole-group step deltas, steps_per_call gauge), every
+# row trains exactly once, and warm host+dispatch wall per step through
+# multi_step(8) is measurably below the single-step path's
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -85,5 +92,6 @@ python scripts/ci_assert_warmstart.py
 python scripts/ci_assert_shared.py
 python scripts/ci_assert_autopilot.py
 python scripts/ci_assert_ha.py
+python scripts/ci_assert_megastep.py
 
 exit $rc

@@ -317,6 +317,12 @@ HEADLINE_METRICS = (
     ("autopilot_items_per_sec", "autopilot_convergence", "higher"),
     ("autopilot_hand_tuned_items_per_sec", "autopilot_convergence",
      "higher"),
+    # megastep engine stamps (absent pre-round-15, skipped by run_diff):
+    # K per dispatch — "higher" because a DROP in the armed K means the
+    # amortization the round's numbers depend on silently regressed
+    ("resnet50_steps_per_call", "resnet", "higher"),
+    ("transformer_lm_steps_per_call", "transformer", "higher"),
+    ("mnist_steps_per_call", "mnist", "higher"),
 )
 
 
@@ -356,6 +362,57 @@ def _bench_rounds():
         if m:
             rounds.append((int(m.group(1)), path))
     return [p for _, p in sorted(rounds)]
+
+
+#: consecutive replayed rounds before a headline MFU/roofline key is
+#: declared stale in --diff output
+STALE_MIN_ROUNDS = 3
+
+
+def _stale_streaks(min_rounds=STALE_MIN_ROUNDS, rounds=None):
+    """Headline MFU/roofline keys whose source leg has been REPLAYED (not
+    measured) in the newest ``min_rounds``+ consecutive archived rounds:
+    ``{metric: (streak, oldest_round, newest_round)}``.  These are the
+    keys a reader most wants to trust (the ≥50%-MFU exit criterion), so a
+    replay streak must be loud, not a footnote in ``leg_sources``."""
+    paths = _bench_rounds() if rounds is None else list(rounds)
+    per_round = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                parsed = _parsed(json.load(f))
+        except (OSError, ValueError):
+            parsed = {}
+        per_round.append((os.path.basename(path), _replayed_legs(parsed)))
+    stale = {}
+    for metric, leg, _ in HEADLINE_METRICS:
+        if "mfu" not in metric and "roofline" not in metric:
+            continue
+        streak, names = 0, []
+        for name, tainted in reversed(per_round):
+            if leg not in tainted:
+                break
+            streak += 1
+            names.append(name)
+        if streak >= min_rounds:
+            stale[metric] = (streak, names[-1], names[0])
+    return stale
+
+
+def _print_stale_banner(stale):
+    """Loud STALE banner for --diff: headline device numbers that have not
+    been re-measured for several consecutive rounds."""
+    if not stale:
+        return
+    bar = "!" * 72
+    print("\n" + bar)
+    print("!!  STALE: headline MFU/roofline keys replayed, NOT re-measured")
+    for metric, (streak, oldest, newest) in sorted(stale.items()):
+        print("!!    %s: replayed %d consecutive rounds (%s .. %s)"
+              % (metric, streak, oldest, newest))
+    print("!!  every number above is a copy of older evidence — the device")
+    print("!!  has not confirmed it recently; treat it as unverified")
+    print(bar)
 
 
 def run_diff(paths, threshold):
@@ -413,6 +470,7 @@ def run_diff(paths, threshold):
             verdict = "ok"
         print(fmt % (metric, "%g" % old, "%g" % new,
                      "%+.1f%%" % pct, verdict))
+    _print_stale_banner(_stale_streaks())
     if regressions:
         print("\n%d headline regression(s) past %.1f%%:" %
               (len(regressions), threshold))

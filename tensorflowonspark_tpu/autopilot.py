@@ -102,6 +102,13 @@ DEFAULT_CONFIG = {
     "latency_slo_p99_us": 0.0,
     "batch_fill_lo_pct": 50.0,
     "batch_fill_hi_pct": 90.0,
+    # megastep K steering (train_steps_per_call): raise K when the
+    # between-dispatch host gap per DISPATCHED step is above the
+    # threshold (host overhead a longer scan would amortize); back K off
+    # when the feed cannot keep groups full (starved wall fraction at or
+    # above the threshold while K > 1)
+    "steps_per_call_gap_hi_us": 1500.0,
+    "steps_per_call_starved_frac": 0.5,
     # bounded in-memory action log + journal snapshot cadence
     "max_actions": 64,
     "journal_snapshot_secs": 10.0,
@@ -116,6 +123,8 @@ DEFAULT_CONFIG = {
 DEFAULT_KNOBS = {
     "infeed_prefetch": {"initial": None, "min": 1, "max": 16,
                         "integer": True, "target": "node"},
+    "train_steps_per_call": {"initial": None, "min": 1, "max": 64,
+                             "integer": True, "target": "node"},
     "dataservice_queue_bound": {"initial": 2, "min": 2, "max": 64,
                                 "integer": True, "target": "node"},
     "dataservice_cache_budget": {"initial": None, "min": 8 << 20,
@@ -346,12 +355,30 @@ class Autopilot(object):
                 worst = frac
         return worst
 
+    def _gap_per_step(self, win):
+        """Worst per-node between-dispatch host gap per DISPATCHED train
+        step (µs) — the host overhead a longer megastep scan amortizes.
+        Per-step (not per-dispatch): otherwise raising K would look worse
+        as each bigger group legitimately waits longer for its data."""
+        worst = None
+        for wd in win["per_node"].values():
+            d = wd["deltas"]
+            steps = d.get("train_steps_total", 0)
+            if steps < self.config["min_events"]:
+                continue
+            gap = d.get("dispatch_gap_us", 0) / steps
+            if worst is None or gap > worst:
+                worst = gap
+        return worst
+
     # objectives are "lower is better" so kept/reverted logic is uniform
     def _objective(self, knob, win):
         d, g, span = win["deltas"], win["gauges"], max(win["span_secs"],
                                                       _EPS)
         if knob == "infeed_prefetch":
             return self._starved_frac(win)
+        if knob == "train_steps_per_call":
+            return self._gap_per_step(win)
         if knob == "dataservice_queue_bound":
             return g.get("dataservice_queue_sat_pct_max")
         if knob == "dataservice_cache_budget":
@@ -377,6 +404,19 @@ class Autopilot(object):
             frac = self._starved_frac(win)
             if frac is not None and frac >= cfg["infeed_starved_frac"]:
                 return {"direction": +1, "signal": "infeed_starved",
+                        "value": round(frac, 4)}
+        elif knob == "train_steps_per_call":
+            gap = self._gap_per_step(win)
+            if gap is not None and gap >= cfg["steps_per_call_gap_hi_us"]:
+                return {"direction": +1, "signal": "dispatch_gap_per_step",
+                        "value": round(gap, 1)}
+            frac = self._starved_frac(win)
+            cur = self._values.get("train_steps_per_call")
+            if frac is not None and cur is not None and cur > 1 and \
+                    frac >= cfg["steps_per_call_starved_frac"]:
+                # groups are waiting on the feed: a smaller K restores
+                # overlap instead of parking the device K batches at a time
+                return {"direction": -1, "signal": "group_starved",
                         "value": round(frac, 4)}
         elif knob == "dataservice_queue_bound":
             sat = g.get("dataservice_queue_sat_pct_max")

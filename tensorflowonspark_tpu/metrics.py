@@ -331,7 +331,7 @@ class TimeHistory(object):
     def on_step_end(self, value=None):
         self.on_steps_end(1, value)
 
-    def on_steps_end(self, n, value=None):
+    def on_steps_end(self, n, value=None, window_value=None):
         """Record ``n`` global steps completed by one dispatch (n > 1 when a
         ``lax.scan`` group ran K steps on device, see ``Trainer.multi_step``).
         A window closes whenever the step counter crosses a ``log_steps``
@@ -342,7 +342,15 @@ class TimeHistory(object):
         stacked ys): the TensorBoard loss curve then keeps full per-step
         density under K-steps-per-dispatch — points buffer as device arrays
         and flush at window boundaries, so no extra syncs enter the
-        pipeline."""
+        pipeline.
+
+        ``window_value`` may carry an O(1) DEVICE SCALAR summarizing the
+        dispatch (e.g. the scan-computed group loss mean): boundaries then
+        sync on it instead of the K-element vector, so the per-boundary
+        device->host readback stays O(1) no matter how large K grows.
+        ``last_synced_value`` becomes that scalar (a mean, not the last
+        step's loss — NaN/Inf still propagate through the mean, so
+        nonfinite health detection keeps working)."""
         if self.train_start_time is None:
             self.on_train_begin()
         before = self.global_steps
@@ -351,7 +359,8 @@ class TimeHistory(object):
         if vec is not None and self.summary_writer is not None:
             self._pending_losses.append((before, vec))
         if self.global_steps // self.log_steps > before // self.log_steps:
-            synced = self._sync(value)
+            synced = self._sync(
+                window_value if window_value is not None else value)
             if synced is not None:
                 self.last_synced_value = synced
             now = time.time()

@@ -64,7 +64,14 @@ _feeds = []
 
 def _register_feed(feed):
     """Register a metrics source for this node's heartbeats (weakref; dead
-    sources are pruned on the next snapshot)."""
+    sources are pruned on the next snapshot).  Idempotent: a source that
+    registers on every fit call (the Trainer does, from ``fit_feed``) must
+    not appear twice — heartbeat merges SUM across registry entries, so a
+    duplicate would double-count its counters, and duplicate
+    ``apply_knob`` hooks would double-ack knob pushes."""
+    for ref in _feeds:
+        if ref() is feed:
+            return
     _feeds.append(weakref.ref(feed))
 
 

@@ -45,6 +45,15 @@ logger = logging.getLogger(__name__)
 PREFETCH_ENV = "TFOS_INFEED_PREFETCH"
 DEFAULT_PREFETCH = 2
 
+#: how K-step groups are assembled when ``group_assembly=None``:
+#: ``"device"`` (default) transfers each batch as it arrives and stacks the
+#: group on device under a tiny jitted assembler — the host never
+#: materializes the K× copy and assembly overlaps the previous dispatch;
+#: ``"host"`` restores the old behavior (np.stack on the prefetch thread,
+#: one big transfer per group).
+GROUP_ASSEMBLY_ENV = "TFOS_GROUP_ASSEMBLY"
+DEFAULT_GROUP_ASSEMBLY = "device"
+
 #: how long :meth:`ShardedFeed.terminate` waits for the prefetch thread — it
 #: can be mid device_put (not interruptible), so the join is bounded, re-
 #: interrupting the feed each round; past the deadline the queue drain is
@@ -95,10 +104,18 @@ class ShardedFeed(object):
         to shard LM token batches over the sequence axis too.  The spec is
         truncated to each leaf's rank (labels ``(B,)`` take just the batch
         axes) and the mask always uses the batch-dim entry alone.
+      group_assembly: how :meth:`grouped_batches` builds its K-step stacks —
+        ``"device"`` (default) transfers each batch as it arrives and stacks
+        on device under a tiny jitted assembler (the host never materializes
+        the K× copy; fresh buffers every group, so the trainer may donate
+        the stack), ``"host"`` keeps the old np.stack-then-one-transfer path
+        (reuses one mask stack, NOT donation-safe).  ``None`` reads
+        ``TFOS_GROUP_ASSEMBLY``.
     """
 
     def __init__(self, feed, mesh, global_batch_size, preprocess=None,
-                 transform=None, pad_final=True, prefetch=None, sharding=None):
+                 transform=None, pad_final=True, prefetch=None, sharding=None,
+                 group_assembly=None):
         import jax
 
         assert preprocess is None or transform is None, \
@@ -114,6 +131,24 @@ class ShardedFeed(object):
             prefetch = int(os.environ.get(PREFETCH_ENV, "")
                            or DEFAULT_PREFETCH)
         self._prefetch_depth = prefetch
+        if group_assembly is None:
+            group_assembly = (os.environ.get(GROUP_ASSEMBLY_ENV, "")
+                              or DEFAULT_GROUP_ASSEMBLY)
+        if group_assembly not in ("device", "host"):
+            raise ValueError(
+                "group_assembly must be 'device' or 'host', got {!r}".format(
+                    group_assembly))
+        self._group_assembly = group_assembly
+        # Live group size: grouped_batches(k) seeds _group_k; an autopilot
+        # train_steps_per_call push lands in _group_k_target and is picked
+        # up at the next group-fill START (never mid-group), so K changes
+        # only between groups and every yielded stack is internally uniform.
+        self._group_k = 0
+        self._group_k_target = None
+        self._group_assembler = None   # jitted device-side stack (lazy)
+        self._scan_shardings = {}      # stacked-ndim -> NamedSharding
+        self._group_assemble_us = 0
+        self._group_assemble_us_hwm = 0
         # Always-on plain-int tallies (the DataFeed/shmring pattern —
         # telemetry reads them at heartbeat cadence, the hot path never
         # pays for a lock or a tracer call): batches transferred, host
@@ -208,14 +243,18 @@ class ShardedFeed(object):
         ``infeed_batches`` (device transfers), ``infeed_assembly_us`` (host
         columnar assembly, INCLUDING time blocked on the upstream feed —
         starvation is separately visible as ``feed_stall_secs``),
-        ``infeed_put_us`` (host->device transfer), and per-batch ``_hwm``
-        high-water marks of both."""
+        ``infeed_put_us`` (host->device transfer), per-batch ``_hwm``
+        high-water marks of both, and ``train_group_assemble_us`` (host wall
+        spent dispatching the jitted device-side K-stack; ~free next to the
+        transfers it replaced)."""
         return {
             "infeed_batches": self._n_batches,
             "infeed_assembly_us": self._assembly_us,
             "infeed_assembly_us_hwm": self._assembly_us_hwm,
             "infeed_put_us": self._put_us,
             "infeed_put_us_hwm": self._put_us_hwm,
+            "train_group_assemble_us": self._group_assemble_us,
+            "train_group_assemble_us_hwm": self._group_assemble_us_hwm,
             # gauge (never summed): the CURRENT depth, so the driver can
             # confirm a live autopilot retune landed
             "infeed_prefetch_depth_max": self._prefetch_depth,
@@ -229,8 +268,26 @@ class ShardedFeed(object):
         mutex, waking blocked putters — a raise takes effect on the very
         next produced batch).  A feed built with ``prefetch=0`` has no
         producer thread to rebound, so a raise there takes effect at the
-        next ``batches()`` call.  Returns True when the knob was claimed.
+        next ``batches()`` call.
+
+        ``train_steps_per_call`` retunes the grouped-iteration K: the new
+        size is parked in a target slot that the grouped iterator reads at
+        each group-fill START, so the change lands exactly on a group
+        boundary (groups already buffered keep their old K; the trainer's
+        per-K program cache handles the mix).  Refused on multi-process
+        meshes: knob pushes arrive per-host on heartbeats, and a transient
+        skew would desync the SPMD group lock-step.  Returns True when the
+        knob was claimed.
         """
+        if name == "train_steps_per_call":
+            if self._num_processes > 1:
+                logger.warning(
+                    "refusing live train_steps_per_call retune on a "
+                    "%d-process mesh (per-host knob delivery skew would "
+                    "desync grouped lock-step)", self._num_processes)
+                return False
+            self._group_k_target = max(int(value), 1)
+            return True
         if name != "infeed_prefetch":
             return False
         depth = max(int(value), 1)
@@ -241,6 +298,19 @@ class ShardedFeed(object):
                 buf.maxsize = depth
                 buf.not_full.notify_all()
         return True
+
+    @property
+    def group_assembly(self):
+        """``"device"`` or ``"host"`` — how grouped stacks are built."""
+        return self._group_assembly
+
+    @property
+    def group_donation_safe(self):
+        """True when every grouped stack (batches AND masks) is built from
+        fresh device buffers each group, so ``multi_step`` may donate them
+        back to the allocator.  Host-stack mode reuses one transferred mask
+        stack across groups and is therefore not donation-safe."""
+        return self._group_assembly == "device"
 
     def _next_local(self):
         """Assemble this host's local batch as final columnar arrays;
@@ -415,7 +485,7 @@ class ShardedFeed(object):
                     grouped_ok = False
                     logger.info("degrading to single-step mode (a host "
                                 "cannot fill a %d-step group)", k)
-                for single in self._degrade(item, k):
+                for single in self._degrade(item):
                     has_data = single is not None
                     if not collectives.end_of_data_consensus(
                             self.mesh, has_data):
@@ -425,10 +495,15 @@ class ShardedFeed(object):
             stop.set()
 
     @staticmethod
-    def _degrade(item, k):
+    def _degrade(item):
         """Split one grouped-iterator item into single-step items (device
         slicing for an assembled group); a trailing ``None`` stays ``None``
         so the caller's consensus sees end-of-feed.
+
+        The group size is read off the mask stack's leading dim (global
+        shape, no transfer) rather than taken from the caller: under the
+        live ``train_steps_per_call`` knob, buffered groups may carry an
+        older K than the current target.
 
         The slice runs under jit: on a multi-host mesh the stacked arrays
         are global (not fully addressable), so eager indexing would be
@@ -441,7 +516,8 @@ class ShardedFeed(object):
             return [item]
         _, stack, masks = item
         slice_fn = _group_slicer()
-        return [("single",) + slice_fn((stack, masks), i) for i in range(k)]
+        return [("single",) + slice_fn((stack, masks), i)
+                for i in range(masks.shape[0])]
 
     def wire_formats(self):
         """Transport/format counts the underlying feed observed, e.g.
@@ -512,60 +588,154 @@ class ShardedFeed(object):
             batch, mask = self._shard(arrays, count)
             yield batch, mask, count
 
+    def _scan_sharding(self, ndim_stacked):
+        """Sharding for a ``(k, B, ...)`` scan stack: leading scan dim
+        unsharded; the rest follows the (possibly overridden) batch sharding
+        truncated to the leaf's rank (cached per rank)."""
+        if ndim_stacked not in self._scan_shardings:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            spec = (None,) + tuple(self._sharding.spec)[:ndim_stacked - 1]
+            self._scan_shardings[ndim_stacked] = NamedSharding(
+                self.mesh, PartitionSpec(*spec))
+        return self._scan_shardings[ndim_stacked]
+
+    def _live_group_k(self):
+        """Current group size, folding in a pending autopilot retune.  Read
+        only at group-fill starts so K changes land on group boundaries."""
+        target = self._group_k_target
+        if target and target != self._group_k:
+            logger.info("grouped infeed: steps_per_call %d -> %d (group "
+                        "boundary)", self._group_k, target)
+            self._group_k = target
+        return self._group_k
+
     def _grouped_sharded_iter(self, k):
         """Yields ``("multi", stack, masks)`` for runs of K full local
-        batches (stacked columnar on host, ONE transfer per group) and
-        ``("single", batch, mask)`` for tails, then a single ``None``.
+        batches and ``("single", batch, mask)`` for tails, then a single
+        ``None``.
 
         Once any batch arrives short (end of feed / epoch tail) the iterator
         stays in single mode — partial batches only occur at the end of the
         feed, and a deterministic mode switch keeps hosts alignable."""
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec
+        self._group_k = max(int(k), 1)
+        if self._group_assembly == "host":
+            return self._grouped_host_iter()
+        return self._grouped_device_iter()
 
-        scan_cache = {}
+    def _group_assembler_fn(self):
+        """Jitted device-side stacker: k device-resident (batch, mask) pairs
+        -> ``(k, B, ...)`` stacks laid out for the scan program.  Retraces
+        only when k (the input list length) changes — expected and cheap
+        under adaptive K."""
+        if self._group_assembler is None:
+            import jax
+            import jax.numpy as jnp
 
-        def scan_sharding(ndim_stacked):
-            # leading scan dim unsharded; the rest follows the (possibly
-            # overridden) batch sharding truncated to the leaf's rank
-            if ndim_stacked not in scan_cache:
-                spec = (None,) + tuple(self._sharding.spec)[:ndim_stacked - 1]
-                scan_cache[ndim_stacked] = NamedSharding(
-                    self.mesh, PartitionSpec(*spec))
-            return scan_cache[ndim_stacked]
+            def assemble(batches, masks):
+                def stack(*xs):
+                    s = jnp.stack(xs)
+                    return jax.lax.with_sharding_constraint(
+                        s, self._scan_sharding(s.ndim))
 
-        def put_stack(cols):
-            stacked = np.stack([np.asarray(c) for c in cols])
-            return jax.make_array_from_process_local_data(
-                scan_sharding(stacked.ndim), stacked)
+                return (jax.tree_util.tree_map(stack, *batches),
+                        stack(*masks))
 
-        # Loop invariant: every group's rows are all real, so the (k, B) mask
-        # stack is built and transferred once and reused for every group
-        # (multi_step does not donate it).
-        masks = None
-        pending = []  # full (arrays, count) locals awaiting a k-group
+            self._group_assembler = jax.jit(assemble)
+        return self._group_assembler
+
+    def _assemble_group(self, pending):
+        """Stack k already-device-resident (batch, mask) pairs on DEVICE.
+        The host never materializes the K× copy; every output buffer is
+        fresh (donation-safe), and when prefetch is on this runs on the
+        prefetch thread, overlapping the previous dispatch."""
+        group = len(pending)
+        start = time.perf_counter()
+        with telemetry.get_tracer().span("infeed/group_assemble",
+                                         group=group):
+            stack, masks = self._group_assembler_fn()(
+                [b for b, _ in pending], [m for _, m in pending])
+        us = int((time.perf_counter() - start) * 1e6)
+        self._group_assemble_us += us
+        if us > self._group_assemble_us_hwm:
+            self._group_assemble_us_hwm = us
+        self._note_flow("infeed_group_assemble", group=group)
+        return ("multi", stack, masks)
+
+    def _grouped_device_iter(self):
+        """Device-stack grouped path: each full batch transfers individually
+        as it arrives (overlapping the previous dispatch), then a tiny
+        jitted assembler stacks the group on device.  Per-batch masks are
+        fresh buffers, so the whole group is donation-safe."""
+        pending = []   # device-resident (batch, mask) pairs awaiting a group
         singles_mode = False
+        group_k = self._live_group_k()
         for local in self._local_iter():
             if local is None:
                 break
             arrays, count = local
             if not singles_mode and count == self.local_batch_size:
+                if not pending:
+                    group_k = self._live_group_k()
+                pending.append(self._shard(arrays, count))
+                if len(pending) >= group_k:
+                    item = self._assemble_group(pending)
+                    pending = []
+                    yield item
+                continue
+            singles_mode = True
+            for b, m in pending:
+                yield ("single", b, m)
+            pending = []
+            b, m = self._shard(arrays, count)
+            yield ("single", b, m)
+        for b, m in pending:
+            yield ("single", b, m)
+        yield None
+
+    def _grouped_host_iter(self):
+        """Host-stack grouped path (``group_assembly="host"``): K host
+        batches np.stack into one ``(k, B, ...)`` array, ONE transfer per
+        group.  Kept as the fallback for hosts where per-batch transfers
+        are slower than one big put; reuses a single transferred all-ones
+        mask stack per K, so it is NOT donation-safe."""
+        import jax
+
+        def put_stack(cols):
+            stacked = np.stack([np.asarray(c) for c in cols])
+            return jax.make_array_from_process_local_data(
+                self._scan_sharding(stacked.ndim), stacked)
+
+        # Loop invariant: every group's rows are all real, so the (k, B)
+        # mask stack is built and transferred once PER GROUP SIZE and reused
+        # (multi_step must not donate it — group_donation_safe is False).
+        mask_cache = {}
+        pending = []  # full columnar locals awaiting a k-group
+        singles_mode = False
+        group_k = self._live_group_k()
+        for local in self._local_iter():
+            if local is None:
+                break
+            arrays, count = local
+            if not singles_mode and count == self.local_batch_size:
+                if not pending:
+                    group_k = self._live_group_k()
                 pending.append(arrays)
-                if len(pending) == k:
+                if len(pending) >= group_k:
                     start = time.perf_counter()
                     with telemetry.get_tracer().span("infeed/device_put",
-                                                     group=k):
+                                                     group=group_k):
                         stack = jax.tree_util.tree_map(
                             lambda *cols: put_stack(cols), *pending)
-                        if masks is None:
-                            masks = put_stack(
+                        if group_k not in mask_cache:
+                            mask_cache[group_k] = put_stack(
                                 [np.ones((self.local_batch_size,),
-                                         np.float32)] * k)
+                                         np.float32)] * group_k)
                     self._tally_put(start)
-                    self._n_batches += k
-                    self._note_flow("infeed_device_put", group=k)
+                    self._n_batches += group_k
+                    self._note_flow("infeed_device_put", group=group_k)
                     pending = []
-                    yield ("multi", stack, masks)
+                    yield ("multi", stack, mask_cache[group_k])
                 continue
             singles_mode = True
             for p in pending:

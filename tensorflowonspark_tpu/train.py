@@ -31,6 +31,11 @@ logger = logging.getLogger(__name__)
 #: disables (the default — guards cost a context switch per dispatch).
 TRANSFER_GUARD_ENV = "TFOS_TRANSFER_GUARD"
 
+#: default K for :meth:`Trainer.fit_feed` when the caller leaves
+#: ``steps_per_call=1`` — lets cluster runs arm K-step grouped dispatch
+#: (the megastep path) without code changes; see docs/API.md.
+STEPS_PER_CALL_ENV = "TFOS_STEPS_PER_CALL"
+
 
 def _resolve_transfer_guard(mode):
     """Normalize a ``fit_feed(transfer_guard=...)`` / env value to a jax
@@ -372,6 +377,16 @@ class Trainer(object):
         self._health_grad = None       # last finite grad norm
         self._nonfinite_loss = 0
         self._nonfinite_grad = 0
+        # Megastep telemetry: dispatched train steps (counter), the K of
+        # the most recent dispatch, and the session-max K (the heartbeat
+        # gauge — the tail of a feed degrades to K=1 singles, so "last K"
+        # would hide that a live train_steps_per_call retune landed), plus
+        # the last requested K from a knob push (recorded for stats
+        # stamping; the grouped feed applies the change on a boundary).
+        self._steps_total = 0
+        self._steps_per_call_gauge = 0
+        self._steps_per_call_hwm = 0
+        self._steps_per_call_req = None
 
     def counters_snapshot(self):
         """Flat overlap + goodput counters for heartbeat payloads /
@@ -410,6 +425,16 @@ class Trainer(object):
             "dispatch_gap_us": self._dispatch_gap_us,
             "dispatch_gap_us_hwm": self._dispatch_gap_us_hwm,
         }
+        if self._steps_total:
+            # dispatched train steps (each multi_step adds K) — pairs with
+            # dispatch_gap_us to give the autopilot a per-dispatched-step
+            # host-overhead signal
+            snap["train_steps_total"] = self._steps_total
+        if self._steps_per_call_hwm:
+            # gauge (merged by max): the largest K any dispatch armed this
+            # session, so the driver can confirm a live train_steps_per_call
+            # retune landed even after the feed tail degrades to singles
+            snap["train_steps_per_call_max"] = self._steps_per_call_hwm
         if self._step_ms_count:
             running = 0
             for bound in metrics_mod.STEP_MS_BUCKETS:
@@ -451,6 +476,20 @@ class Trainer(object):
             for name, pct in attrib.items():
                 snap["attrib_%s_max" % name] = round(pct, 4)
         return snap
+
+    def apply_knob(self, name, value):
+        """Live-knob hook (autopilot KNOB pushes via ``node.apply_knobs``;
+        the trainer registers itself in :meth:`fit_feed`).
+
+        ``train_steps_per_call`` is recorded here for stats stamping and
+        claimed so a trainer-only registry still acks the push; the actual
+        regrouping is done by the :class:`ShardedFeed` (registered in the
+        same process), which applies the new K at the next group-fill
+        start — never mid-group."""
+        if name != "train_steps_per_call":
+            return False
+        self._steps_per_call_req = max(int(value), 1)
+        return True
 
     def attribution_report(self):
         """Decompose the closed-window step-loop wall time into the
@@ -494,6 +533,12 @@ class Trainer(object):
             # reset_history / first use: start from this recorder's origin
             self._acct_history = hist
             self._windows_seen = 1
+        elif self._windows_seen >= len(hist.timestamp_log):
+            # No window closed since the last call — the common case on the
+            # per-dispatch path (boundaries come every log_steps).  O(1)
+            # exit keeps the between-dispatch host work independent of the
+            # accounting below.
+            return
         before_windows = self._windows_seen
         log = hist.timestamp_log
         while self._windows_seen < len(log):
@@ -562,37 +607,56 @@ class Trainer(object):
         ``lax.scan`` over a stacked group of batches (leaves shaped
         ``(k, batch, ...)``).  Amortizes per-step dispatch latency and lets
         XLA overlap the scan iterations' host interactions — the difference
-        between single-digit and real MFU on remotely-attached backends."""
-        if k not in self._multi_cache:
+        between single-digit and real MFU on remotely-attached backends.
+
+        The scan also reduces its window metrics ON DEVICE — per-step
+        losses AND grad norms come out as the full vector plus O(1) means,
+        so the host reads back nothing until a TimeHistory window boundary.
+
+        Note the batch/mask stacks are NOT in ``donate_argnums``: XLA
+        donation is input-output aliasing, and this program has no
+        batch-stack-shaped output to alias into, so donating them would
+        only warn ("donated buffers were not usable") and change nothing.
+        Stack handover is instead the dispatch-side deletion in
+        :meth:`multi_step` (``donate_batches=True``)."""
+        key = k
+        if key not in self._multi_cache:
+            donate = self._donate
+
             def multi(state, batches, masks):
                 def body(st, bm):
                     b, m = bm
-                    new_st, loss, _ = self._step_core(st, b, m)
-                    return new_st, loss
-                state, losses = jax.lax.scan(body, state, (batches, masks))
-                # final loss extracted INSIDE jit: eager indexing on the
-                # scan output would raise on a multi-host mesh, where jit
-                # outputs are global (not fully addressable) arrays
-                return state, (losses, losses[-1])
-            self._multi_cache[k] = jax.jit(
-                multi, donate_argnums=self._donate)
-        return self._multi_cache[k]
+                    new_st, loss, packed = self._step_core(st, b, m)
+                    return new_st, (loss, packed[1])
+                state, (losses, gnorms) = jax.lax.scan(
+                    body, state, (batches, masks))
+                # reductions + final loss extracted INSIDE jit: eager
+                # indexing on the scan output would raise on a multi-host
+                # mesh, where jit outputs are global (not fully
+                # addressable) arrays
+                return state, (losses, losses[-1],
+                               losses.mean(), gnorms.mean())
+            self._multi_cache[key] = jax.jit(multi, donate_argnums=donate)
+        return self._multi_cache[key]
 
     def _get_repeat_step(self, k):
         """Jitted program running ``k`` train steps over the SAME batch in
         one dispatch (``lax.scan`` with no scanned inputs).  The synthetic-
         benchmark counterpart of :meth:`multi_step` (reference benchmark
-        mode reuses one device-resident batch, ``common.py:315-363``)."""
+        mode reuses one device-resident batch, ``common.py:315-363``);
+        returns the same on-device window reductions."""
         key = ("repeat", k)
         if key not in self._multi_cache:
             def repeat(state, batch, mask):
                 def body(st, _):
-                    new_st, loss, _ = self._step_core(st, batch, mask)
-                    return new_st, loss
-                state, losses = jax.lax.scan(body, state, None, length=k)
-                # final loss extracted INSIDE jit (multi-host safety; see
+                    new_st, loss, packed = self._step_core(st, batch, mask)
+                    return new_st, (loss, packed[1])
+                state, (losses, gnorms) = jax.lax.scan(
+                    body, state, None, length=k)
+                # reductions inside jit (multi-host safety; see
                 # _get_multi_step)
-                return state, (losses, losses[-1])
+                return state, (losses, losses[-1],
+                               losses.mean(), gnorms.mean())
             self._multi_cache[key] = jax.jit(
                 repeat, donate_argnums=self._donate)
         return self._multi_cache[key]
@@ -634,7 +698,12 @@ class Trainer(object):
             extra={"program": name, "accum_steps": self.accum_steps,
                    "compute_dtype": str(self.compute_dtype),
                    "program_id": self._aot_program_id,
-                   "program_version": self._aot_program_version})
+                   "program_version": self._aot_program_version,
+                   # output-structure revision of the loop programs (multi/
+                   # repeat grew on-device window reductions): a serialized
+                   # executable from an older revision would deserialize
+                   # fine but return the old structure, so it must miss
+                   "loop_rev": 2})
         compiled, verdict, micros = compilecache.load_or_compile(
             self._aot, name, fp, jit_fn, args)
         self._aot_verdicts[name] = verdict
@@ -725,27 +794,55 @@ class Trainer(object):
         """Run ``k`` steps on one batch in a single dispatch; returns the
         final step's loss.  The full per-step loss vector (the scan's ys)
         goes to the metrics recorder, so the TensorBoard curve keeps
-        per-step density."""
+        per-step density; window boundaries sync only the O(1) on-device
+        loss mean, and the grad-norm mean buffers for the health gauges."""
         fn = self._get_repeat_step(k)
         self._ensure_history(batch, mask)
-        self.state, (losses, final) = self._aot_dispatch(
-            "repeat_%d" % k, fn, (self.state, batch, mask))
-        self.history.on_steps_end(k, losses)
+        self.state, (losses, final, loss_mean, gnorm_mean) = \
+            self._aot_dispatch("repeat_%d" % k, fn,
+                               (self.state, batch, mask))
+        self._health_grad_norm = gnorm_mean
+        self._steps_per_call_gauge = k
+        self._steps_per_call_hwm = max(self._steps_per_call_hwm, k)
+        self._steps_total += k
+        self.history.on_steps_end(k, losses, window_value=loss_mean)
         return final
 
-    def multi_step(self, batches, masks):
+    def multi_step(self, batches, masks, donate_batches=False):
         """Run K steps in one dispatch; ``batches``/``masks`` leaves carry a
         leading scan dim K (see :func:`~...parallel.mesh.scan_batch_sharding`
         and :meth:`~...parallel.infeed.ShardedFeed.grouped_batches`).
         Returns the final step's loss; the per-step loss vector feeds the
         metrics recorder (dense TensorBoard curve under K-steps-per-
-        dispatch)."""
+        dispatch), while window boundaries sync only the O(1) on-device
+        loss mean and the grad-norm mean buffers for the health gauges —
+        between boundaries the host reads back nothing.
+
+        ``donate_batches=True`` hands the stacks' device memory back to the
+        allocator right after dispatch: the buffers are deleted caller-side
+        (PJRT holds them alive until the in-flight dispatch drains), so the
+        K× staging memory is recycled across groups instead of riding the
+        Python references, and any accidental reuse of a handed-over stack
+        raises instead of silently recomputing.  Only legal with a feed
+        whose ``group_donation_safe`` is True — i.e. one that builds FRESH
+        device stacks every group.  (Not ``donate_argnums``: XLA could
+        never alias the stacks into this program's outputs, see
+        :meth:`_get_multi_step`.)"""
         k = int(jax.tree_util.tree_leaves(masks)[0].shape[0])
         fn = self._get_multi_step(k)
         self._ensure_history(batches, masks, stacked=True)
-        self.state, (losses, final) = self._aot_dispatch(
-            "multi_%d" % k, fn, (self.state, batches, masks))
-        self.history.on_steps_end(k, losses)
+        self.state, (losses, final, loss_mean, gnorm_mean) = \
+            self._aot_dispatch("multi_%d" % k, fn,
+                               (self.state, batches, masks))
+        if donate_batches:
+            for leaf in jax.tree_util.tree_leaves((batches, masks)):
+                if hasattr(leaf, "delete"):
+                    leaf.delete()
+        self._health_grad_norm = gnorm_mean
+        self._steps_per_call_gauge = k
+        self._steps_per_call_hwm = max(self._steps_per_call_hwm, k)
+        self._steps_total += k
+        self.history.on_steps_end(k, losses, window_value=loss_mean)
         return final
 
     def evaluate(self, sharded_feed, metric_fn, cache_key=None):
@@ -827,9 +924,12 @@ class Trainer(object):
             "step", self._train_step, (self.state, batch, mask))
         # apply_update rides the grad norm out next to the user aux; keep
         # it as an un-synced device scalar until a window boundary reads it
-        # (multi_step's scan discards aux, so the gauge follows single-step
-        # dispatches only).
+        # (multi_step buffers its scan's on-device grad-norm mean the same
+        # way).
         aux, self._health_grad_norm = packed
+        self._steps_per_call_gauge = 1
+        self._steps_per_call_hwm = max(self._steps_per_call_hwm, 1)
+        self._steps_total += 1
         # Passing the loss lets TimeHistory sync on device completion at
         # window boundaries (honest ms/step + MFU under async dispatch);
         # within a window steps still pipeline.
@@ -849,7 +949,12 @@ class Trainer(object):
         (:meth:`ShardedFeed.grouped_batches`) and runs each group as one
         ``lax.scan`` dispatch (:meth:`multi_step`); tail batches that can't
         fill a group run as ordinary single steps.  ``max_steps`` may be
-        overshot by at most K-1 steps.
+        overshot by at most K-1 steps.  Leaving ``steps_per_call=1`` reads
+        :data:`STEPS_PER_CALL_ENV` (``TFOS_STEPS_PER_CALL``) as the
+        default, and a live ``train_steps_per_call`` autopilot knob can
+        retune K between groups mid-run.  When the feed's
+        ``group_donation_safe`` is True (device-side group assembly) the
+        batch/mask stacks are donated back to the allocator each dispatch.
 
         ``on_steps``: optional ``fn(steps_done)`` called after every
         dispatch (so once per K-step group) — the hook for periodic
@@ -900,6 +1005,24 @@ class Trainer(object):
         # just-dispatched device step and defeat the infeed's double
         # buffering (steps dispatch asynchronously).
         steps_done = int(self.state.step)
+        steps_per_call = int(steps_per_call)
+        if steps_per_call <= 1:
+            # env default so cluster runs can arm grouped (megastep)
+            # dispatch without code changes; an explicit steps_per_call > 1
+            # always wins
+            env_k = os.environ.get(STEPS_PER_CALL_ENV, "")
+            if env_k:
+                try:
+                    steps_per_call = max(int(env_k), 1)
+                except ValueError:
+                    logger.warning("ignoring non-integer %s=%r",
+                                   STEPS_PER_CALL_ENV, env_k)
+        # Donate the batch/mask stacks back to the allocator only when the
+        # feed guarantees fresh device buffers every group (device-side
+        # assembly); host-stack mode and duck-typed feeds handing over
+        # host-backed arrays fall back to the non-donating program.
+        donate_batches = bool(self._donate) and bool(
+            getattr(sharded_feed, "group_donation_safe", False))
         if steps_per_call > 1:
             source = sharded_feed.grouped_batches(steps_per_call)
         else:
@@ -927,7 +1050,8 @@ class Trainer(object):
             with tracer.span("train/dispatch", kind=kind), \
                     _transfer_guard_ctx(guard_level):
                 if kind == "multi":
-                    loss = self.multi_step(batch, mask)
+                    loss = self.multi_step(batch, mask,
+                                           donate_batches=donate_batches)
                     steps_done += int(
                         jax.tree_util.tree_leaves(mask)[0].shape[0])
                 else:
@@ -973,6 +1097,17 @@ class Trainer(object):
         else:
             stats = {}
         stats["overlap"] = overlap
+        # Megastep stamp: how this fit's dispatches were shaped — the bench
+        # legs and the CI gates copy this block into their evidence so every
+        # reported number says which engine produced it.
+        stats["megastep"] = {
+            "steps_per_call": steps_per_call,
+            "steps_per_call_last": self._steps_per_call_gauge or 1,
+            "group_assembly": (getattr(sharded_feed, "group_assembly", None)
+                               if steps_per_call > 1 else None),
+            "donate_state": bool(self._donate),
+            "donate_batches": bool(donate_batches and steps_per_call > 1),
+        }
         return stats
 
     def restore_latest(self, ckpt_manager, validate=False):

@@ -308,6 +308,88 @@ class TestServingSensors:
         assert applied == [{"serving_max_batch": 16}]      # doubled
 
 
+def _gap_window(now, gap_us_per_step, steps=100, span=4.0, starved_frac=0.0):
+    """A window whose per-dispatched-step host gap is ``gap_us_per_step``
+    (cumulative counters, worst node), optionally also feed-starved."""
+    return [(now - span, {"dispatch_count": 0, "train_steps_total": 0,
+                          "dispatch_gap_us": 0,
+                          "goodput_infeed_starved_us": 0}),
+            (now, {"dispatch_count": steps, "train_steps_total": steps,
+                   "dispatch_gap_us": int(gap_us_per_step * steps),
+                   "goodput_infeed_starved_us":
+                       int(starved_frac * span * 1e6)})]
+
+
+class TestMegastepKnob:
+    """train_steps_per_call steering: gap-per-step doubles K, group
+    starvation halves it, a regressing double reverts, and K=1 never
+    halves further."""
+
+    def _k_pilot(self, applied, initial=1, **cfg):
+        ring = _FakeRing()
+        clock = {"now": T0}
+        cfg.setdefault("knobs",
+                       {"train_steps_per_call": {"initial": initial}})
+        p = _make_pilot(ring, clock, actuator=lambda k: applied.append(k),
+                        **cfg)
+        return ring, clock, p
+
+    def test_high_gap_per_step_doubles_k(self):
+        applied = []
+        ring, clock, p = self._k_pilot(applied)
+        for _ in range(2):
+            clock["now"] += 1.0
+            # 2000 us of host gap per dispatched step >= the 1500 default
+            ring.set_window("0", _gap_window(clock["now"], 2000.0))
+            out = p.tick()
+        assert [r["stage"] for r in out] == ["proposed", "applied"]
+        assert out[0]["knob"] == "train_steps_per_call"
+        assert out[0]["from"] == 1 and out[0]["to"] == 2
+        assert out[0]["signal"] == "dispatch_gap_per_step"
+        assert applied == [{"train_steps_per_call": 2}]
+
+    def test_group_starved_halves_k(self):
+        applied = []
+        ring, clock, p = self._k_pilot(applied, initial=4)
+        for _ in range(2):
+            clock["now"] += 1.0
+            # gap is fine (100 us/step) but the feed starves 80% of wall:
+            # a K=4 group parks the device waiting for 4 batches at a time
+            ring.set_window("0", _gap_window(clock["now"], 100.0,
+                                             starved_frac=0.8))
+            out = p.tick()
+        assert [r["stage"] for r in out] == ["proposed", "applied"]
+        assert out[0]["from"] == 4 and out[0]["to"] == 2
+        assert out[0]["signal"] == "group_starved"
+        assert applied == [{"train_steps_per_call": 2}]
+
+    def test_starved_at_k1_never_fires(self):
+        applied = []
+        ring, clock, p = self._k_pilot(applied, initial=1, confirm_ticks=1)
+        clock["now"] += 1.0
+        ring.set_window("0", _gap_window(clock["now"], 100.0,
+                                         starved_frac=0.9))
+        assert p.tick() == []      # K=1 cannot halve; starvation is not
+        assert applied == []       # this knob's problem any more
+
+    def test_regressing_double_reverts_to_old_k(self):
+        applied = []
+        ring, clock, p = self._k_pilot(applied, initial=2, settle_ticks=1,
+                                       revert_margin_frac=0.25)
+        for _ in range(2):
+            clock["now"] += 1.0
+            ring.set_window("0", _gap_window(clock["now"], 2000.0))
+            p.tick()
+        assert applied == [{"train_steps_per_call": 4}]
+        # the settle window measures a WORSE gap: 3000 > 2000 * 1.25
+        clock["now"] += 1.0
+        ring.set_window("0", _gap_window(clock["now"], 3000.0))
+        out = p.tick()
+        assert [r["stage"] for r in out] == ["effect", "reverted"]
+        assert applied[-1] == {"train_steps_per_call": 2}
+        assert p.knob_values()["train_steps_per_call"] == 2
+
+
 class TestJournalRoundTrip:
     def _run_live(self, tmp_path):
         """Scripted live run over a REAL SampleRing with a snapshot_fn so

@@ -15,6 +15,8 @@ import subprocess
 import sys
 import time
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 LM_SMOKE_ENV = {
@@ -208,11 +210,10 @@ def test_probe_device_retries_with_exponential_backoff(monkeypatch):
     sleeps = []
     monkeypatch.setattr(bench.time, "sleep", sleeps.append)
 
-    def timeout_run(*a, **kw):
-        raise bench.subprocess.TimeoutExpired(cmd="probe",
-                                              timeout=kw.get("timeout"))
+    def timeout_probe(code, timeout):
+        raise bench.subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
 
-    monkeypatch.setattr(bench.subprocess, "run", timeout_run)
+    monkeypatch.setattr(bench, "_probe_subprocess", timeout_probe)
     kind, err = bench.probe_device(timeout=1, attempts=3, retry_sleep=60)
     assert kind is None and "timed out" in err
     assert sleeps == [60, 120]
@@ -274,3 +275,77 @@ def test_replayed_leg_restamps_value_source(tmp_path, monkeypatch):
     stats, captured = bench.load_partial_leg("mnist")
     assert captured == now
     assert stats["value_source"] == "replayed"
+
+
+def _import_bench_watch():
+    scripts_dir = os.path.join(ROOT, "scripts")
+    sys.path.insert(0, scripts_dir)
+    try:
+        import bench_watch
+    finally:
+        sys.path.remove(scripts_dir)
+    return bench_watch
+
+
+def test_probe_hard_timeout_kills_process_group():
+    """The probe's timeout is HARD: a child that wedges (here: sleeps past
+    the deadline) is SIGKILLed with its whole process group, and the
+    caller sees TimeoutExpired promptly instead of hanging on the pipe."""
+    bench = _import_bench()
+    t0 = time.monotonic()
+    with pytest.raises(subprocess.TimeoutExpired):
+        bench._probe_subprocess("import time; time.sleep(60)", timeout=1.0)
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_probe_history_carries_diagnostics(monkeypatch):
+    """Every probe attempt records platform / device count / elapsed in
+    PROBE_HISTORY — the round evidence must show WHAT answered, not just
+    that something did."""
+    bench = _import_bench()
+    monkeypatch.setattr(
+        bench, "_probe_subprocess",
+        lambda code, timeout: (
+            0, '{"kind": "cpu", "platform": "cpu", "device_count": 2}\n',
+            ""))
+    del bench.PROBE_HISTORY[:]
+    kind, err = bench.probe_device(timeout=5)
+    assert kind == "cpu" and err is None
+    entry = bench.PROBE_HISTORY[-1]
+    assert entry["error"] is None
+    assert entry["platform"] == "cpu"
+    assert entry["device_count"] == 2
+    assert "elapsed" in entry
+
+
+def test_stale_streak_banner_thresholds(tmp_path):
+    """--diff's STALE detector: a headline MFU/roofline key whose leg was
+    replayed in >= 3 consecutive newest rounds is flagged; a streak broken
+    by one measured round is not."""
+    bench_watch = _import_bench_watch()
+
+    def _round(n, replayed):
+        path = tmp_path / ("BENCH_r%02d.json" % n)
+        with open(path, "w") as f:
+            json.dump({"n": n, "parsed": {
+                "mnist_mfu": 0.1, "resnet50_mfu": 0.2,
+                "replayed_legs": sorted(replayed)}}, f)
+        return str(path)
+
+    # resnet replays in every round; transformer was measured in r03
+    rounds = [_round(1, {"resnet", "transformer"}),
+              _round(2, {"resnet", "transformer"}),
+              _round(3, {"resnet"}),
+              _round(4, {"resnet", "transformer"})]
+    stale = bench_watch._stale_streaks(rounds=rounds)
+    resnet_keys = [k for k in stale if "resnet" in k]
+    assert resnet_keys, stale
+    for key in resnet_keys:
+        streak, oldest, newest = stale[key]
+        assert streak == 4
+        assert oldest == "BENCH_r01.json" and newest == "BENCH_r04.json"
+    # transformer's streak broke at r03: below the 3-round threshold
+    assert not [k for k in stale if "transformer" in k]
+
+    # fewer than STALE_MIN_ROUNDS consecutive replays: quiet
+    assert bench_watch._stale_streaks(rounds=rounds[2:]) == {}

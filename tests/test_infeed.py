@@ -191,6 +191,89 @@ def test_grouped_batches_pending_flush(mgr):
     np.testing.assert_array_equal(np.sort(got), np.arange(16, dtype=np.float32))
 
 
+def test_grouped_device_vs_host_assembly_parity(mgr):
+    """The device-stack assembler must build bit-identical groups to the
+    host np.stack path: same rows -> equal stacks, masks, and kinds."""
+    rows = [[float(i)] for i in range(20)]
+    feeds = {}
+    for mode in ("device", "host"):
+        m2 = manager.start(b"infeed-parity-" + mode.encode(),
+                           ["input", "output", "error"])
+        try:
+            _fill(m2, rows)
+            sf = ShardedFeed(DataFeed(m2), build_mesh(), global_batch_size=8,
+                             prefetch=0, group_assembly=mode)
+            assert sf.group_assembly == mode
+            feeds[mode] = list(sf.grouped_batches(2))
+        finally:
+            m2.shutdown()
+    assert [k for k, _, _ in feeds["device"]] == \
+        [k for k, _, _ in feeds["host"]] == ["multi", "single"]
+    for (_, bd, md), (_, bh, mh) in zip(feeds["device"], feeds["host"]):
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(bh))
+        np.testing.assert_array_equal(np.asarray(md), np.asarray(mh))
+
+
+def test_host_assembly_tail_degrades_to_singles(mgr):
+    """The degrade-to-singles switch works in host-stack mode too (the
+    default device path is covered by the tests above)."""
+    _fill(mgr, [[float(i)] for i in range(20)])
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=2, group_assembly="host")
+    assert not sf.group_donation_safe    # host mode reuses mask stacks
+    out = list(sf.grouped_batches(2))
+    assert [kind for kind, _, _ in out] == ["multi", "single"]
+    got = np.concatenate(
+        [np.asarray(b).reshape(-1, 8)[np.asarray(m).reshape(-1, 8) > 0]
+         for _, b, m in out])
+    np.testing.assert_array_equal(np.sort(got),
+                                  np.arange(20, dtype=np.float32))
+
+
+def test_device_assembly_counters_and_donation(mgr):
+    """Device assembly tallies train_group_assemble_us, keeps the per-batch
+    put tallies alive, and reports donation-safe stacks."""
+    _fill(mgr, [[float(i)] for i in range(32)])
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=0)
+    assert sf.group_assembly == "device"   # default
+    assert sf.group_donation_safe
+    out = list(sf.grouped_batches(2))
+    assert [kind for kind, _, _ in out] == ["multi", "multi"]
+    snap = sf.counters_snapshot()
+    assert snap["train_group_assemble_us"] > 0
+    assert snap["infeed_put_us"] > 0       # per-batch transfers still tallied
+    assert snap["infeed_batches"] == 4
+
+
+def test_apply_knob_retunes_group_size_on_boundary(mgr):
+    """A train_steps_per_call push lands at the NEXT group-fill start: the
+    first group keeps the seeded K, later groups use the new K."""
+    _fill(mgr, [[float(i)] for i in range(48)])   # 6 batches of 8
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=0)
+    it = sf.grouped_batches(2)
+    kind, stack, _ = next(it)
+    assert kind == "multi" and np.asarray(stack).shape[0] == 2
+    assert sf.apply_knob("train_steps_per_call", 4)
+    shapes = [np.asarray(s).shape[0] for kind, s, _ in it if kind == "multi"]
+    assert shapes == [4]                          # remaining 4 batches regroup
+    got = np.asarray(stack).ravel()
+    np.testing.assert_array_equal(np.sort(got),
+                                  np.arange(16, dtype=np.float32))
+
+
+def test_apply_knob_steps_per_call_refused_multiprocess(mgr):
+    """Per-host K retunes are refused on multi-process meshes — a transient
+    knob skew would desync the SPMD group lock-step."""
+    _fill(mgr, [])
+    sf = ShardedFeed(DataFeed(mgr), build_mesh(), global_batch_size=8,
+                     prefetch=0)
+    sf._num_processes = 2
+    assert sf.apply_knob("train_steps_per_call", 4) is False
+    assert sf._group_k_target is None
+
+
 def test_fit_feed_steps_per_call_trains_all_steps(mgr):
     """fit_feed(steps_per_call=2) consumes the same data as single-step mode
     and reports the same step count."""
@@ -245,6 +328,41 @@ def test_fit_feed_on_steps_hook(mgr):
     seen = []
     tr.fit_feed(sf, steps_per_call=2, on_steps=seen.append)
     assert seen == [2, 4]  # one call per 2-step group dispatch
+
+
+def test_fit_feed_steps_per_call_env_default(mgr, monkeypatch):
+    """TFOS_STEPS_PER_CALL supplies the group size when the caller leaves
+    steps_per_call at 1, and the megastep stats block records the mode."""
+    monkeypatch.setenv("TFOS_STEPS_PER_CALL", "2")
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(32):
+        x = [float(v) for v in rng.rand(2)]
+        rows.append((x, float(np.dot(x, [3.14, 1.618]))))
+    _fill(mgr, rows)
+    feed = DataFeed(mgr, input_mapping={"a_x": "x", "b_y": "y"})
+    mesh = build_mesh()
+    sf = ShardedFeed(feed, mesh, global_batch_size=8, prefetch=0)
+
+    from tensorflowonspark_tpu.train import Trainer
+    import jax.numpy as jnp
+
+    def loss(params, batch, mask):
+        pred = jnp.asarray(batch["x"]) @ params["w"]
+        err = (pred - jnp.asarray(batch["y"])) ** 2 * mask
+        return err.sum() / jnp.maximum(mask.sum(), 1.0), {}
+
+    tr = Trainer(loss, {"w": jnp.zeros((2,))}, optax.sgd(0.1), mesh=mesh,
+                 batch_size=8, log_steps=10)
+    stats = tr.fit_feed(sf)                       # steps_per_call left at 1
+    assert stats["global_steps"] == 4
+    mega = stats["megastep"]
+    assert mega["steps_per_call"] == 2            # env took effect
+    assert mega["steps_per_call_last"] == 2
+    assert mega["group_assembly"] == "device"
+    # default Trainer donates state, device assembly is donation-safe
+    assert mega["donate_state"] is True
+    assert mega["donate_batches"] is True
 
 
 def test_trainer_evaluate_exact(mgr):

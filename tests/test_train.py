@@ -268,6 +268,89 @@ class TestMultiStep:
             tr_single.state.params, tr_multi.state.params)
         assert tr_multi.history.global_steps == 4
 
+    def test_multi_step_donated_matches_single_steps(self):
+        """donate_batches=True (device-assembled stacks handed over to the
+        allocator) must be numerically identical to the undonated scan AND
+        to K sequential single steps.  Fresh stacks per call: donation
+        invalidates the input buffers."""
+        from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+        mesh = build_mesh()
+        params = {"w": jnp.zeros((2,)), "b": jnp.zeros(())}
+        opt = optax.sgd(0.1, momentum=0.9)
+        tr_single = Trainer(_linear_loss, params, opt, mesh=mesh,
+                            batch_size=16, log_steps=100)
+        tr_donated = Trainer(_linear_loss, params, opt, mesh=mesh,
+                             batch_size=16, log_steps=100)
+        scan_sharding = mesh_mod.scan_batch_sharding(mesh)
+
+        def fresh_group(seeds):
+            batches = [_make_batch(mesh, n=16, seed=s) for s in seeds]
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jax.device_put(
+                    np.stack([np.asarray(x) for x in xs]), scan_sharding),
+                *batches)
+            masks = jax.device_put(
+                np.ones((len(seeds), 16), np.float32), scan_sharding)
+            return batches, stacked, masks
+
+        for seeds in ([0, 1, 2, 3], [4, 5, 6, 7]):
+            batches, stacked, masks = fresh_group(seeds)
+            for b in batches:
+                last_single, _ = tr_single.step(b)
+            last_donated = tr_donated.multi_step(stacked, masks,
+                                                 donate_batches=True)
+            # donated: the stacks' buffers are gone now — deleted, not stale
+            assert stacked["x"].is_deleted()
+            assert masks.is_deleted()
+
+        np.testing.assert_allclose(float(last_single), float(last_donated),
+                                   rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            tr_single.state.params, tr_donated.state.params)
+        assert tr_donated.history.global_steps == 8
+
+    def test_multi_step_no_host_sync_inside_window(self):
+        """Tentpole invariant: between TimeHistory window boundaries a
+        multi_step dispatch performs NO device-to-host transfer — loss and
+        grad-norm reductions stay on device as O(1) scalars.  Proven by
+        running warm dispatches under transfer_guard('disallow') and
+        checking no boundary closed mid-guard."""
+        from tensorflowonspark_tpu.parallel import mesh as mesh_mod
+
+        mesh = build_mesh()
+        params = {"w": jnp.zeros((2,)), "b": jnp.zeros(())}
+        writer = _CaptureWriter()
+        tr = Trainer(_linear_loss, params, optax.sgd(0.1), mesh=mesh,
+                     batch_size=16, log_steps=100, summary_writer=writer)
+        scan_sharding = mesh_mod.scan_batch_sharding(mesh)
+
+        def group(seeds):
+            batches = [_make_batch(mesh, n=16, seed=s) for s in seeds]
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jax.device_put(
+                    np.stack([np.asarray(x) for x in xs]), scan_sharding),
+                *batches)
+            masks = jax.device_put(
+                np.ones((len(seeds), 16), np.float32), scan_sharding)
+            return stacked, masks
+
+        tr.multi_step(*group([0, 1]))       # warm-up: compile outside guard
+        boundaries_before = len(tr.history.timestamp_log)
+        with jax.transfer_guard_device_to_host("disallow"):
+            for s in (2, 4, 6):
+                tr.multi_step(*group([s, s + 1]))
+        # mid-window: no boundary closed, nothing synced, nothing written
+        assert len(tr.history.timestamp_log) == boundaries_before
+        assert not [p for p in writer.points if "loss" in p[0]]
+        assert tr.history.global_steps == 8
+        # the window closes OUTSIDE the guard and flushes the buffered curve
+        tr.history.on_train_end(tr._health_grad_norm)
+        steps = [s for sc, s in writer.points if "loss" in sc]
+        assert steps == list(range(1, 9))
+
     def test_multi_step_mfu_accounting(self):
         """step_flops from the K-step program is divided by K (per-step)."""
         mesh = build_mesh()

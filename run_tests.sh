@@ -77,7 +77,16 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # train_steps_per_call=8 push through node.apply_knobs lands exactly on a
 # group boundary (whole-group step deltas, steps_per_call gauge), every
 # row trains exactly once, and warm host+dispatch wall per step through
-# multi_step(8) is measurably below the single-step path's
+# multi_step(8) is measurably below the single-step path's, and prove
+# the remediator closes the detect→act loop: a 3-node cluster with an
+# injected straggler and a saturated data plane sees the watchtower
+# name both, the remediator evict the straggler (graceful SIGTERM
+# drain, slot release, elastic replacement admitted) and scale out a
+# feed worker, with exact consumer totals and zero operator input, the
+# journal holding the full proposed→applied→effect chain re-derivable
+# by metrics_replay.py — then a NaN batch injected mid-train trips the
+# nonfinite rule and the remediator rolls back past the poisoned step
+# (quarantined .corrupt) to completion
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -93,5 +102,6 @@ python scripts/ci_assert_shared.py
 python scripts/ci_assert_autopilot.py
 python scripts/ci_assert_ha.py
 python scripts/ci_assert_megastep.py
+python scripts/ci_assert_remediator.py
 
 exit $rc

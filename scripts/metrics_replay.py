@@ -1,5 +1,5 @@
-"""Replay a watchtower or autopilot journal offline: re-derive the
-alert/action stream, render a per-node timeline.
+"""Replay a watchtower, autopilot, or remediator journal offline:
+re-derive the alert/action stream, render a per-node timeline.
 
 The live watchtower journals periodic ``metrics_snapshot()`` records and
 every alert it fired into an append-only JSONL under
@@ -21,6 +21,12 @@ exactly where the live run ACTED: actuation changes the telemetry the
 replay's snapshots recorded, so a kept action's follow-up proposals can
 differ.  Config overrides answer "what would the controller have done at
 other thresholds" against recorded history.
+
+A **remediator** journal (``<log_dir>/remediator/journal.jsonl``) works
+the same way (:func:`tensorflowonspark_tpu.remediator.replay_journal`):
+journaled watchtower alerts re-feed the action plane's decision logic
+dry, the live proposed→applied→effect→kept/reverted topology-action
+stream is printed, and the live-vs-replay proposal divergence reported.
 
 Usage:
   python scripts/metrics_replay.py <journal.jsonl>            # human report
@@ -60,15 +66,20 @@ def _fmt(v):
 
 
 def detect_kind(records):
-    """``"autopilot"`` or ``"watchtower"`` from the journal's own records:
-    the autopilot meta carries a ``knobs`` map and its stream is ``action``
-    records; the watchtower's is ``alert`` records."""
+    """``"autopilot"``, ``"remediator"``, or ``"watchtower"`` from the
+    journal's own records: the autopilot meta carries a ``knobs`` map, the
+    remediator meta a ``families`` list; the watchtower meta has neither
+    and its stream is ``alert`` records."""
     for rec in records:
         if rec.get("kind") == "meta":
-            return "autopilot" if "knobs" in rec else "watchtower"
+            if "knobs" in rec:
+                return "autopilot"
+            if "families" in rec:
+                return "remediator"
+            return "watchtower"
     for rec in records:
         if rec.get("kind") == "action":
-            return "autopilot"
+            return "remediator" if "action" in rec else "autopilot"
         if rec.get("kind") == "alert":
             return "watchtower"
     return "watchtower"
@@ -145,6 +156,80 @@ def autopilot_report(args, records, overrides):
     return 0
 
 
+def _action_proposals(actions):
+    """The comparable decision set for a remediator journal: ``(action,
+    executor)`` of every proposal — replay runs dry, so only the proposed
+    stage exists on both sides."""
+    return {(a.get("action"), str(a.get("executor"))) for a in actions
+            if a.get("stage") == "proposed"}
+
+
+def remediator_report(args, records, overrides):
+    from tensorflowonspark_tpu import remediator
+
+    result = remediator.replay_journal(records, config=overrides)
+    journaled = result["journaled_actions"]
+    replayed = result["actions"]
+    live, rep = _action_proposals(journaled), _action_proposals(replayed)
+    divergence = {"live_only": sorted(live - rep),
+                  "replay_only": sorted(rep - live)}
+
+    if args.json:
+        json.dump({"kind": "remediator", "journal": args.journal,
+                   "snapshots": result["snapshots"],
+                   "alerts": result["alerts"],
+                   "config": result["config"],
+                   "journaled_actions": journaled,
+                   "replayed_actions": replayed,
+                   "divergence": divergence}, sys.stdout, default=str)
+        print()
+        return 0 if (result["snapshots"] or result["alerts"]) else 2
+
+    print("journal: %s (remediator)" % args.journal)
+    print("snapshot records: %d, alert records: %d, journaled actions: %d, "
+          "replayed proposals: %d"
+          % (result["snapshots"], result["alerts"], len(journaled),
+             len(replayed)))
+    t0 = min((r.get("time", 0.0) for r in records
+              if r.get("kind") in ("snapshot", "action", "alert")),
+             default=0.0)
+    if journaled:
+        print("\nlive action stream:")
+        for a in journaled:
+            eff = ""
+            if a.get("stage") in ("effect", "kept", "reverted"):
+                eff = "  objective %s -> %s" % (
+                    _fmt(a.get("objective_before")),
+                    _fmt(a.get("objective_after")))
+            print("  [t+%7.1fs] #%-3s %-9s %-20s executor=%-6s (%s)%s"
+                  % (a.get("time", 0.0) - t0, a.get("seq"), a.get("stage"),
+                     a.get("action"), a.get("executor"), a.get("rule"), eff))
+    else:
+        print("\nno actions journaled by the live run")
+    if replayed:
+        print("\nreplay-derived proposals (decision logic re-run dry):")
+        for a in replayed:
+            print("  [t+%7.1fs] %-20s executor=%-6s (%s)"
+                  % (a.get("time", 0.0) - t0, a.get("action"),
+                     a.get("executor"), a.get("rule")))
+    else:
+        print("\nno proposals re-derived at these thresholds")
+    if divergence["live_only"]:
+        print("\nproposed live but not re-derived (actuation changed the "
+              "telemetry the replay reads, or config overrides): %s"
+              % divergence["live_only"])
+    if divergence["replay_only"]:
+        print("re-derived but never proposed live: %s"
+              % divergence["replay_only"])
+    if not divergence["live_only"] and not divergence["replay_only"]:
+        print("\nlive and replay decision streams agree")
+    if not result["snapshots"] and not result["alerts"]:
+        print("no snapshot or alert records: nothing to evaluate",
+              file=sys.stderr)
+        return 2
+    return 0
+
+
 def build_timeline(records, result, keys):
     """One row per (snapshot time, node): selected counters plus the
     average step time derived from the ``step_ms_*`` histogram deltas and
@@ -205,7 +290,9 @@ def main(argv=None):
                     "per-node timeline / action stream.")
     ap.add_argument("journal",
                     help="path to a watchtower or autopilot journal.jsonl")
-    ap.add_argument("--kind", choices=("auto", "watchtower", "autopilot"),
+    ap.add_argument("--kind",
+                    choices=("auto", "watchtower", "autopilot",
+                             "remediator"),
                     default="auto",
                     help="journal flavor (default: detect from the meta "
                          "record)")
@@ -230,6 +317,8 @@ def main(argv=None):
     kind = args.kind if args.kind != "auto" else detect_kind(records)
     if kind == "autopilot":
         return autopilot_report(args, records, overrides)
+    if kind == "remediator":
+        return remediator_report(args, records, overrides)
     result = watchtower.replay_journal(records, config=overrides)
     rows = build_timeline(records, result, keys)
     if args.limit:

@@ -50,23 +50,23 @@ node's next beat reply carries the ``knobs`` dict exactly once (the
 ``PROF``/``reregister`` pattern).  See docs/AUTOPILOT.md.
 """
 
-import json
 import logging
 import math
-import os
 import threading
 import time
 
 from . import telemetry
-from .watchtower import (json_safe, read_journal as _read_journal,
-                         window_deltas)
+from .guardrails import STAGES, Guardrails, JsonlJournal
+from .watchtower import read_journal as _read_journal, window_deltas
 
 logger = logging.getLogger(__name__)
 
 JOURNAL_VERSION = 1
 
-#: action lifecycle stages, in order — the journal's ``stage`` vocabulary
-STAGES = ("proposed", "applied", "effect", "kept", "reverted")
+# STAGES (the proposed→applied→effect→kept/reverted lifecycle vocabulary)
+# now lives in guardrails.py, shared with the remediator; re-exported here
+# for compatibility.
+assert STAGES[0] == "proposed"
 
 #: every tunable threshold in one place; ``cluster.run(..., autopilot={...})``
 #: overrides key-wise (unknown keys raise, same contract as the watchtower)
@@ -212,8 +212,7 @@ class Autopilot(object):
         self._on_action = on_action
         self._clock = clock
         self.journal_path = journal_path
-        self._journal = None
-        self._journal_lock = threading.Lock()
+        self._journal = JsonlJournal(journal_path, owner="autopilot")
         self._last_journal_snap = 0.0
         self.dry_run = bool(self.config["dry_run"])
         # driver-side shadow of each knob's current value
@@ -222,10 +221,10 @@ class Autopilot(object):
         for name, value in (resume_values or {}).items():
             if name in self._values and value is not None:
                 self._values[name] = value
-        self._cooldown_until = {}
-        self._streak = {}          # knob -> consecutive firing ticks
+        # shared gating state: streaks + cooldowns + the one in-flight slot
+        self._guard = Guardrails(self.config["cooldown_secs"],
+                                 self.config["revert_cooldown_secs"])
         self._hints = {}           # knob -> (direction, alert_time, rule)
-        self._pending = None       # the one action in flight
         self._seq = 0
         self._ticks = 0
         self._actions = []         # bounded recent action records
@@ -258,13 +257,7 @@ class Autopilot(object):
         if t is not None:
             t.join(timeout=5.0)
             self._journal_snapshot(force=True)
-        with self._journal_lock:
-            j, self._journal = self._journal, None
-            if j is not None:
-                try:
-                    j.close()
-                except OSError:
-                    pass
+        self._journal.close()
 
     def _loop(self):
         interval = self.config["interval_secs"]
@@ -302,7 +295,7 @@ class Autopilot(object):
         win = self._measure(now)
         # settle phase first: while an action is in flight nothing else
         # moves, so its effect stays attributable
-        if self._pending is not None:
+        if self._guard.pending is not None:
             emitted.extend(self._judge_pending(win, now, tick))
         elif win["nodes"]:
             emitted.extend(self._consider(win, now, tick))
@@ -483,7 +476,7 @@ class Autopilot(object):
         emitted = []
         window = self.config["window_secs"]
         for knob in self.config["knobs"]:
-            if now < self._cooldown_until.get(knob, 0.0):
+            if self._guard.in_cooldown(knob, now):
                 continue
             sensed = self._sense(knob, win)
             if sensed is None:
@@ -493,15 +486,14 @@ class Autopilot(object):
                     sensed = {"direction": hint[0], "signal": hint[2],
                               "value": None, "hint": True}
             if sensed is None:
-                self._streak[knob] = 0
+                self._guard.clear_streak(knob)
                 continue
-            streak = self._streak.get(knob, 0) + 1
-            self._streak[knob] = streak
+            streak = self._guard.bump_streak(knob)
             if streak < self.config["confirm_ticks"]:
                 continue  # hysteresis: one noisy window never turns a knob
             to = self._step(knob, sensed["direction"], sensed)
             if to is None:
-                self._streak[knob] = 0
+                self._guard.clear_streak(knob)
                 continue
             emitted.extend(self._act(knob, to, sensed, win, now, tick))
             break  # one action in flight at a time
@@ -517,29 +509,29 @@ class Autopilot(object):
                 "value": sensed.get("value"), "tick": tick}
         out = [self._record(dict(base, stage="proposed",
                                  objective_before=objective, time=now))]
-        self._streak[knob] = 0
+        self._guard.clear_streak(knob)
         self._hints.pop(knob, None)
         if self.dry_run or self.actuator is None:
             # dry run: propose + journal, never actuate; cooldown still
             # applies so the journal is a decision stream, not a firehose
-            self._cooldown_until[knob] = now + self.config["cooldown_secs"]
+            self._guard.start_cooldown(knob, now)
             return out
         try:
             self.actuator({knob: to})
         except Exception:
             logger.warning("autopilot actuation failed for %s", knob,
                            exc_info=True)
-            self._cooldown_until[knob] = now + self.config["cooldown_secs"]
+            self._guard.start_cooldown(knob, now)
             return out
         self._values[knob] = to
-        self._pending = dict(base, objective_before=objective,
-                             applied_tick=tick, applied_time=now)
+        self._guard.begin(dict(base, objective_before=objective,
+                               applied_tick=tick, applied_time=now))
         out.append(self._record(dict(base, stage="applied",
                                      objective_before=objective, time=now)))
         return out
 
     def _judge_pending(self, win, now, tick):
-        pend = self._pending
+        pend = self._guard.pending
         if tick - pend["applied_tick"] < self.config["settle_ticks"]:
             return []
         knob = pend["knob"]
@@ -557,7 +549,7 @@ class Autopilot(object):
             rel = (after - before) / scale
             if rel > self.config["revert_margin_frac"]:
                 regressed = True
-        self._pending = None
+        self._guard.settle()
         if regressed:
             try:
                 if self.actuator is not None:
@@ -566,13 +558,12 @@ class Autopilot(object):
                 logger.warning("autopilot revert actuation failed for %s",
                                knob, exc_info=True)
             self._values[knob] = pend["from"]
-            self._cooldown_until[knob] = \
-                now + self.config["revert_cooldown_secs"]
+            self._guard.start_cooldown(knob, now, reverted=True)
             out.append(self._record(dict(
                 base, stage="reverted", tick=tick, time=now,
                 objective_before=before, objective_after=after)))
         else:
-            self._cooldown_until[knob] = now + self.config["cooldown_secs"]
+            self._guard.start_cooldown(knob, now)
             out.append(self._record(dict(
                 base, stage="kept", tick=tick, time=now,
                 objective_before=before, objective_after=after)))
@@ -632,11 +623,9 @@ class Autopilot(object):
                 "interval_secs": self.config["interval_secs"],
                 "window_secs": self.config["window_secs"],
                 "knobs": dict(self._values),
-                "cooldowns": {k: round(until - now, 2)
-                              for k, until in self._cooldown_until.items()
-                              if until > now},
-                "pending": (None if self._pending is None
-                            else {k: self._pending[k]
+                "cooldowns": self._guard.cooldowns(now),
+                "pending": (None if self._guard.pending is None
+                            else {k: self._guard.pending[k]
                                   for k in ("seq", "knob", "from", "to",
                                             "signal")}),
                 "action_counts": dict(self._counts),
@@ -644,28 +633,10 @@ class Autopilot(object):
                 "journal": self.journal_path,
             }
 
-    # -- journal -----------------------------------------------------------
-
-    def _journal_open(self):
-        if self.journal_path is None:
-            return None
-        if self._journal is None:
-            parent = os.path.dirname(os.path.abspath(self.journal_path))
-            os.makedirs(parent, exist_ok=True)
-            self._journal = open(self.journal_path, "a")
-        return self._journal
+    # -- journal (shared JsonlJournal — see guardrails.py) ------------------
 
     def _journal_write(self, record):
-        with self._journal_lock:
-            try:
-                j = self._journal_open()
-                if j is None:
-                    return
-                j.write(json.dumps(json_safe(record), default=str) + "\n")
-                j.flush()  # must survive a driver crash mid-run
-            except Exception:
-                logger.warning("autopilot journal write failed",
-                               exc_info=True)
+        self._journal.write(record)
 
     def _journal_meta(self):
         cfg = {k: v for k, v in self.config.items() if k != "knobs"}

@@ -66,6 +66,24 @@ def aot_root(directory):
     return os.path.join(_fs_path(directory), "aot_executables")
 
 
+def _nonfinite_leaves(state):
+    """Key paths of floating-point leaves holding any NaN/Inf — the
+    poison-step marker :meth:`CheckpointManager.restore_latest_valid` uses
+    to quarantine checkpoints saved AFTER a nonfinite update landed.  One
+    device sync per float leaf; recovery-path only."""
+    import jax
+    import jax.numpy as jnp
+
+    bad = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state):
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None or not jnp.issubdtype(dtype, jnp.floating):
+            continue
+        if not bool(jnp.all(jnp.isfinite(leaf))):
+            bad.append(jax.tree_util.keystr(path) or "<root>")
+    return bad
+
+
 class CheckpointManager(object):
     """Chief-only periodic checkpointing of a train-state pytree.
 
@@ -307,9 +325,13 @@ class CheckpointManager(object):
         defeats the point of retaining ``max_to_keep`` steps.
 
         Per candidate (newest first): the step dir must exist under its
-        final (committed) name with content, and the restore itself must
+        final (committed) name with content, the restore itself must
         succeed into ``abstract_state`` — the restore is the authoritative
-        structure/integrity check, there is no cheaper proxy orbax exposes.
+        structure/integrity check, there is no cheaper proxy orbax exposes —
+        and every floating-point leaf must be FINITE (a checkpoint saved
+        after a poison step carries NaN/Inf params; restoring it would
+        resume training on poisoned state, which is exactly what the
+        remediator's rollback exists to undo).
         An invalid step is QUARANTINED by renaming its dir to
         ``<step>.corrupt`` (orbax no longer lists it; operators can inspect
         it), then the previous retained step is tried.  Returns
@@ -345,6 +367,11 @@ class CheckpointManager(object):
                             "save)".format(step_dir))
                     state = self._mgr.restore(
                         step, args=ocp.args.StandardRestore(abstract_state))
+                    poisoned = _nonfinite_leaves(state)
+                    if poisoned:
+                        raise ValueError(
+                            "nonfinite values in restored state: {}".format(
+                                ", ".join(poisoned[:4])))
             except Exception:
                 logger.warning(
                     "checkpoint step %d failed validation; quarantining and "

@@ -45,7 +45,8 @@ class TPUCluster(object):
 
     def __init__(self, backend, cluster_meta, cluster_info, input_mode,
                  server, start_job, tf_status, queues, observatory=None,
-                 profiling=None, watchtower=None, autopilot=None):
+                 profiling=None, watchtower=None, autopilot=None,
+                 remediator=None):
         self.backend = backend
         self.cluster_meta = cluster_meta
         self.cluster_info = cluster_info
@@ -72,6 +73,12 @@ class TPUCluster(object):
         # its final journal snapshot and action tallies precede the
         # watchtower/observatory teardown (see _latch_telemetry)
         self.autopilot = autopilot
+        # optional remediator.Remediator (cluster.run(remediator=True)):
+        # the topology action plane over admitted watchtower alerts;
+        # stopped before everything else on shutdown — its subprocess
+        # pools (scale-out workers/replicas) must die before the
+        # dispatcher/roster they talk to (see _latch_telemetry)
+        self.remediator = remediator
 
     # -- data plane -------------------------------------------------------
 
@@ -283,6 +290,20 @@ class TPUCluster(object):
                 self.tf_status.setdefault("telemetry", snap)
         except Exception:
             logger.debug("telemetry latch failed", exc_info=True)
+        if self.remediator is not None:
+            # stop the action plane before every other controller: its
+            # spawned subprocesses (scale-out feed workers / serving
+            # replicas) must drain while the dispatcher and roster they
+            # talk to still exist, and the action tallies belong in
+            # tf_status next to the telemetry latch
+            try:
+                self.remediator.stop()
+                counts = self.remediator.action_counts()
+                if counts:
+                    self.tf_status.setdefault("remediations", counts)
+            except Exception:
+                logger.debug("remediator stop failed", exc_info=True)
+            telemetry_mod.unregister_flight_source("remediations")
         if self.autopilot is not None:
             # stop the controller before the rule engine that feeds it
             # hints: the final journal snapshot and the action tallies
@@ -351,6 +372,20 @@ class TPUCluster(object):
         ``TFCluster.py:177-181``) — fail-fast, so schedulers notice.
         """
         logger.info("Stopping cluster")
+        # Shutdown must target the LIVE roster: an elastic replacement (or a
+        # remediator eviction) may have swapped an executor since launch, and
+        # poisoning through the stale entry would schedule the shutdown task
+        # on an executor whose cluster_info row no longer matches its manager.
+        try:
+            info = self.server.reservations.get()
+            info.sort(key=node._sort_key)
+            if info != self.cluster_info:
+                self.cluster_info[:] = info
+                logger.info(
+                    "shutdown targeting refreshed roster (generation %d)",
+                    self.server.reservations.generation)
+        except Exception:
+            pass  # reservation server already gone; fall back to the snapshot
         timer = None
         if timeout > 0 and threading.current_thread() is threading.main_thread():
             # Watchdog so a hung node cannot wedge the driver forever
@@ -548,7 +583,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         driver_ps_nodes=False, heartbeat_interval=5.0, heartbeat_misses=3,
         telemetry=False, telemetry_dir=None, data_service=None,
         observatory=False, observatory_port=0, watchtower=None,
-        autopilot=False, compile_cache_dir=None):
+        autopilot=False, remediator=False, compile_cache_dir=None):
     """Start a cluster: one long-running node task per executor (reference
     ``TFCluster.py:210-378``).
 
@@ -626,6 +661,23 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
         ``<log_dir>/autopilot/journal.jsonl`` and surfaces on ``GET
         /autopilot`` plus ``tfos_autopilot_*`` counters on ``/metrics``.
         See docs/AUTOPILOT.md.
+      remediator: topology action plane over admitted watchtower alerts
+        (see :mod:`~tensorflowonspark_tpu.remediator`; requires
+        ``observatory=True`` and the watchtower): ``False`` (default) off,
+        ``True`` on with defaults, a dict overrides key-wise (see
+        ``remediator.DEFAULT_CONFIG``; ``{"dry_run": True}`` journals
+        proposals without actuating).  Closes the detect→act loop the
+        watchtower only observes: persistent stragglers are fenced and
+        replaced (graceful node-side SIGTERM drain + elastic slot
+        re-admission), ``nonfinite`` crits roll training back to the last
+        finite checkpoint (poisoned steps quarantined as
+        ``<step>.corrupt``), sustained data-plane saturation scales feed
+        workers out (``worker_spawn_argv``), and serving SLO burn scales
+        gateway replicas (``serving_spawn_argv``).  Every action is
+        journaled to ``<log_dir>/remediator/journal.jsonl`` and surfaces
+        on ``GET /remediations`` plus ``tfos_remediation_actions_total``
+        on ``/metrics``; final tallies latch into
+        ``tf_status["remediations"]``.  See docs/FAULT_TOLERANCE.md.
       compile_cache_dir: warm-start compile plane
         (:mod:`~tensorflowonspark_tpu.compilecache`): every node points
         JAX's persistent compilation cache at this cluster-shared
@@ -771,9 +823,16 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
     profiling_coord = None
     wt = None
     pilot = None
+    rem = None
     if autopilot and not observatory:
         raise ValueError("autopilot= requires observatory=True: the "
                          "controller reads the observatory's sample ring")
+    if remediator and not observatory:
+        raise ValueError("remediator= requires observatory=True: the action "
+                         "plane consumes the watchtower's admitted alerts")
+    if remediator and watchtower is False:
+        raise ValueError("remediator= requires the watchtower: its admitted "
+                         "alerts ARE the detect half of the detect→act loop")
     if observatory:
         from tensorflowonspark_tpu import observatory as observatory_mod
         from tensorflowonspark_tpu import profiling as profiling_mod
@@ -835,6 +894,63 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
             logger.info("autopilot engaged (dry_run=%s), journal at %s",
                         pilot.config["dry_run"], pilot.journal_path)
 
+        if remediator:
+            from tensorflowonspark_tpu import remediator as remediator_mod
+
+            def _evict_straggler(executor, alert):
+                # Fence + replace, in dependency order: the evict command
+                # is queued FIRST (the node drains it from its next beat
+                # reply and SIGTERMs itself — graceful feed drain, chief
+                # emergency checkpoint, BYE), then the driver releases the
+                # roster slot, excludes the executor backend-side, and
+                # dispatches a replacement into the freed slot.  The
+                # released node keeps beating until its drain completes
+                # (only *dead* executors are fenced from the beat
+                # channel), so the command always reaches it; its BYE
+                # later pops the beat entry, so no death is declared and
+                # no second replacement fires.
+                try:
+                    eid = int(executor)
+                except (TypeError, ValueError):
+                    eid = executor
+                meta = server.reservations.find(eid)
+                if meta is None:
+                    raise RuntimeError(
+                        "executor {} holds no reservation".format(executor))
+                token = "evict-{}-{}".format(eid, int(time.time() * 1000))
+                server.push_knobs({"remediator_evict": token},
+                                  executor_id=eid)
+                if hasattr(cluster_backend, "exclude"):
+                    cluster_backend.exclude(eid)
+                replaced = _request_replacement(meta)
+                return {"executor": eid, "token": token,
+                        "replaced": bool(replaced),
+                        "job_name": meta.get("job_name"),
+                        "task_index": meta.get("task_index")}
+
+            def _rollback_poison(executor, alert):
+                # Broadcast, not targeted: every trainer honours the
+                # rollback — the chief's restore quarantines the poisoned
+                # step(s); workers re-restore the same validated step.
+                token = "rollback-{}".format(int(time.time() * 1000))
+                server.push_knobs({"train_rollback": token})
+                ev = (alert or {}).get("evidence") or {}
+                return {"token": token,
+                        "train_steps_total": ev.get("train_steps_total")}
+
+            rem = remediator_mod.Remediator(
+                ring,
+                actions={"evict": _evict_straggler,
+                         "rollback": _rollback_poison},
+                snapshot_fn=server.metrics_snapshot,
+                config=(dict(remediator) if isinstance(remediator, dict)
+                        else None),
+                journal_path=os.path.abspath(os.path.join(
+                    log_dir or ".", "remediator", "journal.jsonl")))
+            rem.start()
+            logger.info("remediator engaged (dry_run=%s), journal at %s",
+                        rem.dry_run, rem.journal_path)
+
         def _profiler_addresses():
             # lazy: the observatory starts before the roster exists, and the
             # roster can change on replacement admission
@@ -851,6 +967,21 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                 tf_status.setdefault("suspects", {})[str(executor)] = (
                     alert.get("rule"))
 
+            # Admitted alerts fan out to every consumer plane: the
+            # autopilot treats them as retune hints, the remediator as
+            # triggers for topology actions.
+            _alert_sinks = [s for s in (
+                pilot.observe_alert if pilot is not None else None,
+                rem.observe_alert if rem is not None else None)
+                if s is not None]
+
+            def _fan_alert(alert):
+                for sink in _alert_sinks:
+                    try:
+                        sink(alert)
+                    except Exception:
+                        logger.warning("alert sink failed", exc_info=True)
+
             wt = watchtower_mod.Watchtower(
                 ring=ring, snapshot_fn=server.metrics_snapshot,
                 heartbeat_interval=heartbeat_interval,
@@ -859,14 +990,16 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
                     log_dir or ".", "watchtower", "journal.jsonl")),
                 on_suspect=_on_suspect, beat_ages_fn=server.beat_ages,
                 coordinator_fn=server.ha_status,
-                on_alert=(pilot.observe_alert if pilot is not None
-                          else None))
+                on_alert=(_fan_alert if _alert_sinks else None))
             wt.start()
             # Flight records (SIGUSR1 / stall dumps) now carry the metric
             # trajectory and alert log leading into the stall.
             telemetry_mod.register_flight_source("sample_ring_tail",
                                                  wt.ring_tail)
             telemetry_mod.register_flight_source("alerts", wt.alerts)
+            if rem is not None:
+                telemetry_mod.register_flight_source("remediations",
+                                                     rem.actions)
 
         obs = observatory_mod.ObservatoryServer(
             server.metrics_snapshot, ring=ring,
@@ -874,7 +1007,7 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
             profile_fn=profiling_coord.trigger,
             profiler_addresses_fn=_profiler_addresses,
             capture_status_fn=profiling_coord.status,
-            watchtower=wt, autopilot=pilot,
+            watchtower=wt, autopilot=pilot, remediator=rem,
             coordinator_fn=server.ha_status)
         addr = obs.start()
         logger.info("observatory serving /metrics, /status, /profile and "
@@ -1014,4 +1147,4 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
     return TPUCluster(cluster_backend, cluster_meta, cluster_info, input_mode,
                       server, start_job, tf_status, tuple(queues),
                       observatory=obs, profiling=profiling_coord,
-                      watchtower=wt, autopilot=pilot)
+                      watchtower=wt, autopilot=pilot, remediator=rem)

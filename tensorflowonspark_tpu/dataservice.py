@@ -2896,6 +2896,9 @@ class ServiceFeed(object):
     def _get_interruptible(self):
         if not self._errors.empty():
             raise self._errors.get()
+        # chaos hook: ``saturate_consumer_secs`` slow-drains this pop so
+        # the prefetch queue pins at capacity (NULL injector: one no-op)
+        self._fault.on_consume()
         t0 = time.monotonic()
         try:
             while not self._interrupt.is_set():

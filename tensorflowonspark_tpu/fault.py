@@ -52,6 +52,24 @@ class InjectedFailure(RuntimeError):
     """
 
 
+class PoisonRollback(Exception):
+    """Raised inside the training loop when the remediator pushes the
+    ``train_rollback`` command knob (a watchtower ``nonfinite`` crit
+    alert): the dispatch loop halts, ``fit_supervised`` quarantines the
+    poisoned checkpoint step(s) via ``restore_latest_valid`` (the
+    ``<step>.corrupt`` convention) and resumes from the last valid one.
+    Deliberately NOT an :class:`InjectedFailure` — this is a control-plane
+    signal, not a simulated fault, and it must never be classified
+    retryable (the rollback path handles it explicitly without consuming
+    a retry attempt)."""
+
+    def __init__(self, step=None, token=None):
+        super(PoisonRollback, self).__init__(
+            "poison rollback requested at host step {}".format(step))
+        self.step = step
+        self.token = token
+
+
 def fail(message="injected failure"):
     """Raise an :class:`InjectedFailure` unconditionally.
 
@@ -203,6 +221,12 @@ class _NullInjector(object):
     def corrupt_batch(self, batch, step=None):
         return batch
 
+    def on_consume(self):
+        pass
+
+    def traffic_multiplier(self):
+        return 1.0
+
     def should_drop_heartbeat(self, beats_sent):
         return False
 
@@ -269,6 +293,19 @@ class FaultInjector(object):
       every floating leaf of ONE batch with NaN (:meth:`corrupt_batch`,
       fires once) — the NaN'd loss then arises through real training math,
       exercising the window-boundary nonfinite tallies end to end.
+    - ``saturate_consumer_secs``: for this many seconds after the FIRST
+      consumer pop (:meth:`on_consume`, wired into the ServiceFeed's
+      chunk-drain loop), every pop sleeps ``saturate_consumer_sleep``
+      (default 0.05 s) — a timed slow-drain that pins the prefetch queue
+      at capacity and forces the watchtower's ``dataservice_saturation``
+      rule, then releases so the run still completes.  The remediator's
+      worker scale-out chaos gate rides this.
+    - ``traffic_surge``: ``{"mult": M, "secs": S}`` — a timed QPS
+      multiplier for serving chaos: :meth:`traffic_multiplier` returns
+      ``M`` for ``S`` seconds after its first call, then 1.0.  Load
+      generators poll it per request batch, so one env spec turns a
+      steady drive into a surge that burns the latency SLO
+      (``latency_slo_burn`` -> remediator serving scale-out).
     - ``drop_heartbeats_after``: heartbeat sender emits N beats, then goes
       silent while the process lives (tests missed-beat detection without a
       real death).
@@ -295,6 +332,10 @@ class FaultInjector(object):
         self._chunks = 0
         self._splits = 0
         self._slow_fired = False
+        self._consume_t0 = None   # first on_consume() (slow-drain anchor)
+        self._consume_fired = False
+        self._surge_t0 = None     # first traffic_multiplier() (surge anchor)
+        self._surge_fired = False
 
     @staticmethod
     def _fired(kind, flush=False, **attrs):
@@ -417,6 +458,48 @@ class FaultInjector(object):
             return x
 
         return jax.tree_util.tree_map(nanify, batch)
+
+    def on_consume(self):
+        """ServiceFeed chunk-drain hook: for ``saturate_consumer_secs``
+        seconds after the first pop, sleep ``saturate_consumer_sleep``
+        per pop — the producer pins the prefetch queue at capacity
+        (``dataservice_saturation`` fires) and then the drain recovers,
+        so the run still finishes."""
+        secs = self.spec.get("saturate_consumer_secs")
+        if not secs:
+            return
+        now = time.monotonic()
+        if self._consume_t0 is None:
+            self._consume_t0 = now
+        if now - self._consume_t0 > secs:
+            return
+        if not self._consume_fired:
+            self._consume_fired = True
+            logger.warning("FaultInjector: slow-draining consumer pid %d "
+                           "for %.1fs", os.getpid(), secs)
+            self._fired("saturate_consumer", secs=secs)
+        time.sleep(self.spec.get("saturate_consumer_sleep", 0.05))
+
+    def traffic_multiplier(self):
+        """Serving-chaos hook: the current offered-load multiplier.  With
+        ``traffic_surge`` ``{"mult": M, "secs": S}`` armed, returns ``M``
+        for ``S`` seconds after the first poll, else 1.0 — load
+        generators scale their request rate by it per batch."""
+        surge = self.spec.get("traffic_surge")
+        if not surge:
+            return 1.0
+        now = time.monotonic()
+        if self._surge_t0 is None:
+            self._surge_t0 = now
+        if now - self._surge_t0 > surge.get("secs", 0):
+            return 1.0
+        if not self._surge_fired:
+            self._surge_fired = True
+            logger.warning("FaultInjector: traffic surge x%s for %ss",
+                           surge.get("mult", 1.0), surge.get("secs", 0))
+            self._fired("traffic_surge", mult=surge.get("mult", 1.0),
+                        secs=surge.get("secs", 0))
+        return float(surge.get("mult", 1.0))
 
     def should_drop_heartbeat(self, beats_sent):
         """Heartbeat-sender hook: True once ``drop_heartbeats_after`` beats

@@ -79,6 +79,29 @@ def _register_feed(feed):
 # driver can see that its KNOB pushes actually landed on this node.
 _knob_counters = {"autopilot_knobs_applied": 0}
 
+# Remediator eviction tokens already honoured by this process.  The knob
+# coordinator re-broadcasts a push on every heartbeat until drained, and the
+# SIGTERM drain takes a couple hundred ms — without the dedupe a second beat
+# reply could double-fire the timer.
+_evict_tokens = set()
+
+
+def _evict_self(token):
+    """Fence honoured node-side: raise SIGTERM against our own process so
+    the installed preemption drain runs (feed drain, chief emergency
+    checkpoint, BYE goodbye) — the exact path a real preemption takes, so
+    eviction inherits its guarantees.  The in-flight Spark feed task then
+    fails retryably in the executor parent and PR 3's re-dispatch moves the
+    partitions to surviving executors (exact totals preserved)."""
+    logger.warning("remediator eviction (token %s): draining via SIGTERM",
+                   token)
+    telemetry.get_tracer().instant("remediator/evict_self", token=str(token),
+                                   flush=True)
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+    except OSError:  # pragma: no cover - process already unwinding
+        logger.exception("self-eviction signal failed")
+
 
 def apply_knobs(knobs):
     """Apply a ``{knob: value}`` dict from an autopilot KNOB push to every
@@ -89,8 +112,20 @@ def apply_knobs(knobs):
     (ShardedFeed, ServiceFeed, DataFeed) gets a chance at each knob; names
     nothing claims are ignored — a training node silently skips
     ``serving_*`` knobs and vice versa.  Returns the number of (source,
-    knob) applications that took effect."""
+    knob) applications that took effect.
+
+    ``remediator_evict`` is intercepted BEFORE the fan-out: it is a
+    command to this process (fence + drain + exit), not a tunable any
+    feed owns.  The value is a one-shot token (dedupe against heartbeat
+    re-broadcast); a short timer lets the beat cycle ack the knob as
+    drained before the SIGTERM lands."""
+    knobs = dict(knobs or {})
+    evict_token = knobs.pop("remediator_evict", None)
     applied = 0
+    if evict_token is not None and str(evict_token) not in _evict_tokens:
+        _evict_tokens.add(str(evict_token))
+        applied += 1
+        threading.Timer(0.2, _evict_self, args=(evict_token,)).start()
     for name, value in (knobs or {}).items():
         for ref in list(_feeds):
             feed = ref()

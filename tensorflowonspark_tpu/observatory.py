@@ -23,7 +23,8 @@ dependency:
   ``tf_status`` + ``metrics_snapshot`` + ring depths), and — when a
   watchtower is attached — ``GET /alerts`` (the bounded alert log) — and,
   when an autopilot is attached, ``GET /autopilot`` (knob values, pending
-  action, bounded action log),
+  action, bounded action log) — and, when a remediator is attached,
+  ``GET /remediations`` (standing alerts, budgets, bounded action log),
   started by ``cluster.run(..., observatory=True)`` next to the
   rendezvous and stopped with it.  Every render works from ONE snapshot
   copy taken at scrape start, so a node dying mid-scrape can never
@@ -301,7 +302,7 @@ def _render_histogram(fams, executor, counters):
 def render_prometheus(snapshot, ring=None, window_secs=60.0,
                       scrapes=None, alert_counts=None, info=None,
                       autopilot_counts=None, autopilot_ticks=None,
-                      coordinator=None):
+                      remediation_counts=None, coordinator=None):
     """Prometheus text exposition (0.0.4) from one metrics snapshot.
 
     ``snapshot`` is the ``{"nodes": {id: counters}, "aggregate": {...}}``
@@ -312,7 +313,9 @@ def render_prometheus(snapshot, ring=None, window_secs=60.0,
     typically ``Watchtower.alert_counts``) the ``tfos_alerts_total``
     family; ``autopilot_counts`` (``{stage: n}``, typically
     ``Autopilot.action_counts``) the ``tfos_autopilot_actions_total``
-    family plus ``tfos_autopilot_ticks_total``; ``info``
+    family plus ``tfos_autopilot_ticks_total``; ``remediation_counts``
+    (``{action: {stage: n}}``, typically ``Remediator.action_counts``)
+    the ``tfos_remediation_actions_total{action,stage}`` family; ``info``
     (:func:`build_info`) the ``tfos_build_info`` gauge.
     """
     nodes = (snapshot or {}).get("nodes") or {}
@@ -352,6 +355,18 @@ def render_prometheus(snapshot, ring=None, window_secs=60.0,
         fams.add("tfos_autopilot_ticks_total", "counter",
                  "Autopilot controller ticks executed.",
                  "tfos_autopilot_ticks_total %d" % autopilot_ticks)
+    if remediation_counts:
+        for action in sorted(remediation_counts):
+            stages = remediation_counts[action] or {}
+            for stage in sorted(stages):
+                fams.add("tfos_remediation_actions_total", "counter",
+                         "Remediator topology actions, by action family "
+                         "and lifecycle stage "
+                         "(proposed/applied/effect/kept/reverted).",
+                         'tfos_remediation_actions_total{action="%s",'
+                         'stage="%s"} %s'
+                         % (_escape_label(action), _escape_label(stage),
+                            _fmt_value(stages[stage])))
     if coordinator:
         # Coordinator-HA plane (reservation.Server.ha_status): fencing
         # epoch, journal footprint, recovery/supersession state — the
@@ -442,7 +457,7 @@ class ObservatoryServer(object):
                  host="0.0.0.0", port=0, window_secs=60.0,
                  profile_fn=None, profiler_addresses_fn=None,
                  capture_status_fn=None, watchtower=None, autopilot=None,
-                 coordinator_fn=None):
+                 remediator=None, coordinator_fn=None):
         """``profile_fn(duration_ms=, steps=)`` backs ``GET /profile``
         (typically ``CaptureCoordinator.trigger``; 503 when absent).
         ``profiler_addresses_fn`` / ``capture_status_fn`` enrich ``/status``
@@ -453,6 +468,9 @@ class ObservatoryServer(object):
         ``tfos_alerts_total`` counters on ``/metrics``.  ``autopilot`` (an
         ``autopilot.Autopilot``) backs ``GET /autopilot``, the ``/status``
         autopilot block, and the ``tfos_autopilot_*`` counters.
+        ``remediator`` (a ``remediator.Remediator``) backs ``GET
+        /remediations``, the ``/status`` remediator block, and the
+        ``tfos_remediation_actions_total`` counters.
         ``coordinator_fn`` (typically ``reservation.Server.ha_status``)
         backs the ``/status`` coordinator block and the
         ``tfos_coordinator_*`` metrics (fencing epoch, journal footprint,
@@ -465,6 +483,7 @@ class ObservatoryServer(object):
         self._capture_status_fn = capture_status_fn
         self.watchtower = watchtower
         self.autopilot = autopilot
+        self.remediator = remediator
         self._build_info = None
         self.ring = ring if ring is not None else SampleRing()
         self._window_secs = window_secs
@@ -505,6 +524,12 @@ class ObservatoryServer(object):
             except Exception:
                 autopilot_counts = None
                 autopilot_ticks = None
+        remediation_counts = None
+        if self.remediator is not None:
+            try:
+                remediation_counts = self.remediator.action_counts()
+            except Exception:
+                remediation_counts = None
         coordinator = None
         if self._coordinator_fn is not None:
             try:
@@ -518,6 +543,7 @@ class ObservatoryServer(object):
                                  info=self._build_info,
                                  autopilot_counts=autopilot_counts,
                                  autopilot_ticks=autopilot_ticks,
+                                 remediation_counts=remediation_counts,
                                  coordinator=coordinator)
 
     def _alerts_json(self, query):
@@ -564,6 +590,26 @@ class ObservatoryServer(object):
             return 500, json.dumps({"error": repr(e)})
         return 200, json.dumps(payload, default=str)
 
+    def _remediations_json(self, query):
+        if self.remediator is None:
+            return 503, json.dumps(
+                {"error": "remediator is not enabled on this cluster"})
+        import urllib.parse
+
+        params = urllib.parse.parse_qs(query or "")
+        try:
+            limit = int(params["limit"][0]) if params.get("limit") else None
+        except ValueError:
+            return 400, json.dumps({"error": "limit must be an integer"})
+        try:
+            payload = dict(self.remediator.status(), time=time.time())
+            if limit is not None:
+                payload["actions"] = self.remediator.actions(limit=limit)
+        except Exception as e:
+            logger.exception("observatory: /remediations failed")
+            return 500, json.dumps({"error": repr(e)})
+        return 200, json.dumps(payload, default=str)
+
     def _status_json(self):
         try:
             snapshot = self._snapshot_fn()
@@ -607,6 +653,11 @@ class ObservatoryServer(object):
                 payload["autopilot"] = self.autopilot.status()
             except Exception:
                 payload["autopilot"] = None
+        if self.remediator is not None:
+            try:
+                payload["remediator"] = self.remediator.status()
+            except Exception:
+                payload["remediator"] = None
         if self._coordinator_fn is not None:
             try:
                 payload["coordinator"] = self._coordinator_fn()
@@ -678,9 +729,13 @@ class ObservatoryServer(object):
                     code, text = observatory._autopilot_json(query)
                     body = text.encode("utf-8")
                     ctype = "application/json"
+                elif path in ("/remediations", "/remediations/"):
+                    code, text = observatory._remediations_json(query)
+                    body = text.encode("utf-8")
+                    ctype = "application/json"
                 elif path == "/":
                     body = (b"tfos observatory: /metrics /status "
-                            b"/profile /alerts /autopilot\n")
+                            b"/profile /alerts /autopilot /remediations\n")
                     ctype = "text/plain; charset=utf-8"
                 else:
                     self.send_error(404)

@@ -196,6 +196,18 @@ class Reservations(object):
                     return meta
         return None
 
+    def find(self, executor_id):
+        """Copy of the reservation meta held by ``executor_id``, or ``None``.
+        The remediator's eviction action reads the role identity
+        (``job_name``/``task_index``) here BEFORE fencing — release/replace
+        need it, and ``_reservations`` is otherwise private."""
+        with self._lock:
+            for meta in self._reservations:
+                if (isinstance(meta, dict)
+                        and meta.get("executor_id") == executor_id):
+                    return dict(meta)
+        return None
+
     def released_slots(self):
         """Snapshot of freed ``(job_name, task_index)`` slots not yet
         reclaimed by a replacement."""

@@ -377,6 +377,14 @@ class Trainer(object):
         self._health_grad = None       # last finite grad norm
         self._nonfinite_loss = 0
         self._nonfinite_grad = 0
+        # Poison-step rollback (remediator ``train_rollback`` command knob):
+        # a pending request token armed by apply_knob, the set of tokens
+        # already honoured (the knob coordinator re-broadcasts on every
+        # heartbeat, so dedupe lives here), and a completed-rollback tally
+        # published as ``train_rollbacks_total``.
+        self._rollback_req = None
+        self._rollback_tokens = set()
+        self._rollbacks = 0
         # Megastep telemetry: dispatched train steps (counter), the K of
         # the most recent dispatch, and the session-max K (the heartbeat
         # gauge — the tail of a feed degrades to K=1 singles, so "last K"
@@ -471,6 +479,8 @@ class Trainer(object):
                 snap["train_loss_max"] = self._health_loss
             if self._health_grad is not None:
                 snap["train_grad_norm_max"] = round(self._health_grad, 6)
+        if self._rollbacks:
+            snap["train_rollbacks_total"] = self._rollbacks
         attrib = self.attribution_report()
         if attrib:
             for name, pct in attrib.items():
@@ -485,7 +495,22 @@ class Trainer(object):
         claimed so a trainer-only registry still acks the push; the actual
         regrouping is done by the :class:`ShardedFeed` (registered in the
         same process), which applies the new K at the next group-fill
-        start — never mid-group."""
+        start — never mid-group.
+
+        ``train_rollback`` is the remediator's poison-step command: the
+        value is a one-shot token (knob pushes re-broadcast on every
+        heartbeat, so tokens already honoured are dropped here).  Arming it
+        makes the next :meth:`fit_feed` iteration raise
+        :class:`~tensorflowonspark_tpu.fault.PoisonRollback`, which
+        :func:`fit_supervised` turns into a validated restore — the
+        poisoned checkpoint step(s) are quarantined and training resumes
+        from the last valid one."""
+        if name == "train_rollback":
+            token = str(value)
+            if token not in self._rollback_tokens:
+                self._rollback_tokens.add(token)
+                self._rollback_req = token
+            return True
         if name != "train_steps_per_call":
             return False
         self._steps_per_call_req = max(int(value), 1)
@@ -1035,6 +1060,17 @@ class Trainer(object):
         pop_flow = getattr(sharded_feed, "pop_dispatch_flow", None)
         prev_return = None
         for kind, batch, mask in source:
+            if self._rollback_req is not None:
+                # Remediator poison-step command: stop dispatching NOW —
+                # every further step trains on poisoned params.  Drain the
+                # feed (unblocks producers, like the max_steps early stop)
+                # and hand control to fit_supervised's rollback path.
+                token, self._rollback_req = self._rollback_req, None
+                if hasattr(sharded_feed, "terminate"):
+                    sharded_feed.terminate()
+                tracer.instant("train/rollback_halt", step=steps_done,
+                               token=token)
+                raise fault_mod.PoisonRollback(step=steps_done, token=token)
             injector.on_step(steps_done)
             batch = injector.corrupt_batch(batch, steps_done)
             start = time.perf_counter()
@@ -1227,8 +1263,16 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
                                 on_steps=_on_steps,
                                 transfer_guard=transfer_guard)
 
+    # Poison-step rollbacks (remediator ``train_rollback`` command) are
+    # control-plane signals, not failures: they re-enter the restore path
+    # WITHOUT consuming a retry attempt or paying backoff.  The bound only
+    # stops a pathological loop (e.g. every checkpoint quarantined and the
+    # in-memory seed state itself poisoned).
+    max_rollbacks = 4
+    attempt = 0
+    rollbacks = 0
     try:
-        for attempt in range(policy.max_attempts):
+        while True:
             restore_t0 = time.perf_counter()
             with tracer.span("train/restore", attempt=attempt + 1):
                 restored = trainer.restore_latest(ckpt_manager, validate=True)
@@ -1252,20 +1296,34 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
                                         force=True)
                 ckpt_manager.wait_until_finished()
                 return stats
-            except Exception as e:
-                if (not policy.is_retryable(e)
-                        or attempt + 1 >= policy.max_attempts):
+            except fault_mod.PoisonRollback as rb:
+                rollbacks += 1
+                if rollbacks > max_rollbacks:
                     raise
-                delay = policy.backoff(attempt)
+                trainer._rollbacks = rollbacks
+                logger.warning(
+                    "poison rollback %d/%d at host step %s: restoring last "
+                    "VALID checkpoint (poisoned steps quarantined as "
+                    "<step>.corrupt)", rollbacks, max_rollbacks, rb.step)
+                tracer.instant("train/rollback", step=rb.step, token=rb.token,
+                               rollbacks=rollbacks)
+                # Loop straight back to restore_latest(validate=True): it
+                # walks newest-first, quarantines every checkpoint that
+                # fails validation, and restores the last valid one.
+            except Exception as e:
+                attempt += 1
+                if (not policy.is_retryable(e)
+                        or attempt >= policy.max_attempts):
+                    raise
+                delay = policy.backoff(attempt - 1)
                 logger.warning(
                     "supervised fit attempt %d/%d failed (%s: %s); restoring "
-                    "latest checkpoint and retrying in %.1fs", attempt + 1,
+                    "latest checkpoint and retrying in %.1fs", attempt,
                     policy.max_attempts, type(e).__name__, e, delay)
-                tracer.instant("train/retry", attempt=attempt + 1,
+                tracer.instant("train/retry", attempt=attempt,
                                delay_secs=delay, error=repr(e))
                 time.sleep(delay)
                 # Backoff is pure recovery wall time: the devices sit idle.
                 trainer._goodput_recovery_us += int(delay * 1e6)
-        raise AssertionError("unreachable")  # pragma: no cover
     finally:
         node_mod.remove_preemption_callback(_emergency_save)

@@ -236,6 +236,11 @@ class RuleEngine(object):
         self._beat_ages = None       # per-evaluate liveness input
         self._coordinator = None     # per-evaluate HA status input
         self._last_epoch = None      # fencing epoch seen at last evaluate
+        # (rule, executor) -> consecutive evaluates the pair has fired;
+        # stamped on every alert as ``persists_windows`` so consumers (the
+        # remediator's confirm gate) can tell one-shot from sustained
+        # without keeping their own streak state
+        self._persist = {}
         self.rules = (
             ("straggler_step_time", self._rule_straggler_step_time),
             ("straggler_dispatch_gap", self._rule_straggler_dispatch_gap),
@@ -292,6 +297,18 @@ class RuleEngine(object):
             except Exception:
                 logger.warning("watchtower rule %s failed", name,
                                exc_info=True)
+        # persistence streaks: a (rule, executor) pair that fired on the
+        # previous evaluate too extends its streak, anything that went
+        # quiet resets.  Engine state, so live and replay stamp the same
+        # values (every alert is deduped AFTER this, by design — the
+        # deduper's cooldown must not starve the streak).
+        fresh = {}
+        for a in alerts:
+            key = (a.get("rule"), a.get("executor"))
+            fresh[key] = max(fresh.get(key, 0),
+                             self._persist.get(key, 0) + 1)
+            a["persists_windows"] = fresh[key]
+        self._persist = fresh
         order = {"crit": 0, "warn": 1}
         alerts.sort(key=lambda a: order.get(a.get("severity"), 2))
         return alerts
@@ -337,6 +354,7 @@ class RuleEngine(object):
         """
         cfg = self.config
         values = {}
+        windows = {}
         for node, samples in window.items():
             if len(samples) < cfg["min_samples"]:
                 continue
@@ -346,6 +364,7 @@ class RuleEngine(object):
             v = signal(d)
             if v is not None and _finite(v):
                 values[node] = v
+                windows[node] = d
         if len(values) < cfg["straggler_min_nodes"]:
             return []
         alerts = []
@@ -357,10 +376,24 @@ class RuleEngine(object):
                         floor)
             z = (v - med) / scale
             if z >= cfg["straggler_z"]:
+                d = windows[node]
                 alerts.append(self._alert(
                     rule, now, executor=node, severity="warn", value=v,
                     threshold=cfg["straggler_z"], z=round(z, 2),
                     cluster_median=med,
+                    # everything an action plane needs, without the ring:
+                    # the scored value, the peer field it lost to, and the
+                    # suspect's own window deltas
+                    evidence={"value": v, "unit": unit,
+                              "z": round(z, 2), "threshold_z":
+                              cfg["straggler_z"], "peer_median": med,
+                              "peers": len(peers),
+                              "span_secs": round(d["span_secs"], 3),
+                              "deltas": {k: d["deltas"][k] for k in
+                                         ("step_ms_count", "step_ms_sum_us",
+                                          "dispatch_count", "dispatch_gap_us",
+                                          "goodput_infeed_starved_us")
+                                         if k in d["deltas"]}},
                     message="executor {} {}={:.3g}{} vs peer median "
                             "{:.3g}{} (z={:.1f})".format(
                                 node, rule.replace("straggler_", ""), v,
@@ -406,6 +439,16 @@ class RuleEngine(object):
                 alerts.append(self._alert(
                     "nonfinite", now, executor=node, severity="crit",
                     value=total, threshold=0,
+                    # the rollback plane needs WHERE the run was when the
+                    # corruption surfaced — the step tally bounds the
+                    # poison step without another ring query
+                    evidence=dict(detail, new=total - seen,
+                                  train_steps_total=latest.get(
+                                      "train_steps_total"),
+                                  train_loss_max=latest.get(
+                                      "train_loss_max"),
+                                  train_grad_norm_max=latest.get(
+                                      "train_grad_norm_max")),
                     message="executor {} reported {} nonfinite training "
                             "value(s): {}".format(node, total, detail or
                                                   {"total": total}),
@@ -475,10 +518,22 @@ class RuleEngine(object):
             _, latest = samples[-1]
             sat = latest.get("dataservice_queue_sat_pct_max")
             if _finite(sat) and sat >= cfg["queue_sat_pct"]:
+                d = window_deltas(samples)
                 alerts.append(self._alert(
                     "dataservice_saturation", now, executor=node,
                     severity="warn", value=sat,
                     threshold=cfg["queue_sat_pct"],
+                    evidence={"queue_sat_pct": sat,
+                              "threshold_pct": cfg["queue_sat_pct"],
+                              "queue_bound": latest.get(
+                                  "dataservice_queue_bound_max"),
+                              "span_secs": (round(d["span_secs"], 3)
+                                            if d else None),
+                              "items_delta": (d["deltas"].get(
+                                  "dataservice_items", 0) if d else None),
+                              "stall_delta": (d["deltas"].get(
+                                  "dataservice_stall_secs", 0)
+                                  if d else None)},
                     message="executor {} data-service prefetch queue at "
                             "{:.0f}% fill".format(node, sat)))
         return alerts
@@ -552,6 +607,14 @@ class RuleEngine(object):
                 "latency_slo_burn", now, executor=node, severity="warn",
                 value=round(frac, 3), threshold=cfg["latency_slo_burn_frac"],
                 p99_us=p99s[-1], slo_us=slo, shed=shed,
+                evidence={"p99_us": p99s[-1], "slo_us": slo,
+                          "burn_frac": round(frac, 3), "shed": shed,
+                          "span_secs": (round(d["span_secs"], 3)
+                                        if d else None),
+                          "requests_delta": (d["deltas"].get(
+                              "serving_requests", 0) if d else None),
+                          "batch_fill_pct": samples[-1][1].get(
+                              "serving_batch_fill_pct_max")},
                 message="replica {} burning latency SLO: p99 {:.0f}us >= "
                         "{:.0f}us in {:.0%} of window samples ({} shed)"
                         .format(node, p99s[-1], slo, frac, shed)))

@@ -86,7 +86,13 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # journal holding the full proposed→applied→effect chain re-derivable
 # by metrics_replay.py — then a NaN batch injected mid-train trips the
 # nonfinite rule and the remediator rolls back past the poisoned step
-# (quarantined .corrupt) to completion
+# (quarantined .corrupt) to completion — and prove the request plane
+# explains itself: two traced replicas (one with an injected 50ms
+# dispatch stall) serve four concurrent clients, per-stage latency
+# histograms re-add to the e2e sum on /metrics, /slow names the stalled
+# requests by client-minted id, slo_budget_burn pages the slow replica
+# only, the merged timeline stitches cross-process request flows, and
+# metrics_replay.py re-derives the identical verdicts from the journal
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -103,5 +109,6 @@ python scripts/ci_assert_autopilot.py
 python scripts/ci_assert_ha.py
 python scripts/ci_assert_megastep.py
 python scripts/ci_assert_remediator.py
+python scripts/ci_assert_reqtrace.py
 
 exit $rc

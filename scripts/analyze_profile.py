@@ -193,10 +193,36 @@ def merge_capture(capture_dir, telemetry_dir=None):
         except Exception as e:
             notes.append("%s: load failed (%s)" % (path, e))
 
+    flows = request_flow_summary(merged)
+    if flows["ids"]:
+        notes.append("request flows: %d ids, %d crossing process boundaries"
+                     % (flows["ids"], flows["cross_pid"]))
+
     return ({"traceEvents": merged, "displayTimeUnit": "ms",
              "otherData": {"capture_id": manifest.get("capture_id"),
-                           "sources": len(xplanes) + len(host_traces)}},
+                           "sources": len(xplanes) + len(host_traces),
+                           "request_flows": flows}},
             manifest, notes)
+
+
+def request_flow_summary(events):
+    """Tally ``serving/request_flow`` flow events (cat ``tfos_flow``, the
+    gateway's per-request trace flow): distinct flow ids and how many of
+    them cross process boundaries — a cross-pid id is one request whose
+    client, admission, dispatch and reply legs stitch into a single
+    Perfetto track."""
+    pids_by_id = {}
+    for ev in events:
+        if ev.get("cat") != "tfos_flow":
+            continue
+        if ev.get("name") != "serving/request_flow":
+            continue
+        fid = ev.get("id")
+        if fid is None:
+            continue
+        pids_by_id.setdefault(fid, set()).add(ev.get("pid"))
+    cross = sum(1 for pids in pids_by_id.values() if len(pids) >= 2)
+    return {"ids": len(pids_by_id), "cross_pid": cross}
 
 
 def attribution_rows(manifest):

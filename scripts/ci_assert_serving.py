@@ -14,7 +14,7 @@ the whole chain inside the budget:
    requests lost, every prediction numerically correct,
 3. the serving telemetry made it through heartbeats to ``/metrics``
    (nonzero ``tfos_serving_p99_us*`` and ``tfos_serving_batch_fill*``
-   gauges) and the armed ``latency_slo_burn`` rule is visible on
+   gauges) and the armed ``slo_budget_burn`` rule is visible on
    ``/alerts``.
 
 Run next to the elastic/dataservice/watchtower gates in run_tests.sh.
@@ -51,7 +51,7 @@ def _spawn_replica(roster_addr, replica_id, task_index, export_dir,
            "--roster", "{}:{}".format(*roster_addr),
            "--replica-id", replica_id, "--task-index", str(task_index),
            "--max-batch", str(MAX_BATCH), "--max-wait-ms", "5",
-           "--heartbeat", "0.25"]
+           "--heartbeat", "0.25", "--slo-latency-us", "1"]
     if warm_dir:
         cmd += ["--warm-cache-dir", warm_dir]
     return subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
@@ -77,8 +77,10 @@ def main():
                             input_signature={"x": [None, 2]})
 
     # roster + observability plane (the cluster.py wiring, minimal form);
-    # the 1us SLO is intentionally absurd: every real batch violates it, so
-    # the gate proves the burn rule's plumbing, not a tuned threshold
+    # the replicas run --slo-latency-us 1 — intentionally absurd, every
+    # real request violates it, so err_rate ~1.0 burns the 1% budget at
+    # ~100x and the gate proves the burn rule's plumbing, not a tuned
+    # threshold.  Windows shrink from SRE hours to gate seconds.
     resv = reservation.Server(2, heartbeat_interval=0.25,
                               heartbeat_misses=2)
     ring = observatory.SampleRing()
@@ -87,8 +89,11 @@ def main():
         ring=ring, snapshot_fn=resv.metrics_snapshot,
         heartbeat_interval=0.25,
         config={"interval_secs": 0.25, "min_samples": 3,
-                "cooldown_secs": 5.0, "latency_slo_p99_us": 1.0,
-                "latency_slo_burn_frac": 0.5})
+                "cooldown_secs": 5.0, "slo_objective": 0.99,
+                "slo_fast_windows_secs": (1.0, 3.0),
+                "slo_slow_windows_secs": (2.0, 6.0),
+                "slo_burn_fast": 2.0, "slo_burn_slow": 1.5,
+                "slo_min_requests": 5})
     wt.start()
     obs = observatory.ObservatoryServer(resv.metrics_snapshot, ring=ring,
                                         host="127.0.0.1", watchtower=wt)
@@ -244,11 +249,11 @@ def main():
         while burn is None and time.time() < deadline:
             doc = json.loads(_get(base, "/alerts"))
             for a in doc.get("alerts") or []:
-                if a.get("rule") == "latency_slo_burn":
+                if a.get("rule") == "slo_budget_burn":
                     burn = a
                     break
             time.sleep(0.2)
-        assert burn is not None, "latency_slo_burn never fired on /alerts"
+        assert burn is not None, "slo_budget_burn never fired on /alerts"
 
         for c in clients:
             c.close()

@@ -145,7 +145,10 @@ ALERT_HINTS = {
     "infeed_starved": ("infeed_prefetch", +1),
     "dataservice_saturation": ("dataservice_queue_bound", +1),
     "cache_thrash": ("dataservice_cache_budget", +1),
+    # slo_budget_burn superseded latency_slo_burn (PR 19); the old name
+    # stays mapped so journal replays of earlier runs still resolve hints
     "latency_slo_burn": ("serving_max_wait_ms", -1),
+    "slo_budget_burn": ("serving_max_wait_ms", -1),
 }
 
 _EPS = 1e-9

@@ -288,6 +288,14 @@ class TPUCluster(object):
             snap = self.server.metrics_snapshot()
             if snap.get("nodes"):
                 self.tf_status.setdefault("telemetry", snap)
+                # slow-request exemplars ride serving heartbeats; latch the
+                # cluster-wide worst offenders so a finished run still names
+                # the requests that blew its tail latency
+                from tensorflowonspark_tpu import observatory as observatory_mod
+
+                slow = observatory_mod.collect_slow(snap)
+                if slow:
+                    self.tf_status.setdefault("serving_slow", slow)
         except Exception:
             logger.debug("telemetry latch failed", exc_info=True)
         if self.remediator is not None:
@@ -1008,7 +1016,8 @@ def run(cluster_backend, map_fun, tf_args, num_executors=None, num_ps=0,
             profiler_addresses_fn=_profiler_addresses,
             capture_status_fn=profiling_coord.status,
             watchtower=wt, autopilot=pilot, remediator=rem,
-            coordinator_fn=server.ha_status)
+            coordinator_fn=server.ha_status,
+            beat_ages_fn=server.beat_ages)
         addr = obs.start()
         logger.info("observatory serving /metrics, /status, /profile and "
                     "/alerts at http://%s:%d", addr[0], addr[1])

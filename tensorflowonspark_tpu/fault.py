@@ -218,6 +218,9 @@ class _NullInjector(object):
     def on_step(self, step=None):
         pass
 
+    def on_predict(self, rows=None, batch=None):
+        pass
+
     def corrupt_batch(self, batch, step=None):
         return batch
 
@@ -289,6 +292,11 @@ class FaultInjector(object):
     - ``sleep_per_step_secs``: sleep this long in the training loop before
       EVERY dispatch (:meth:`on_step`) — turns this node into a straggler
       the watchtower's cross-node rules must name without killing anything.
+    - ``sleep_per_predict_secs``: the serving-plane analogue — the gateway
+      batcher sleeps this long before EVERY model dispatch
+      (:meth:`on_predict`), inflating the ``dispatch_us`` stage so
+      request-trace/latency-decomposition assertions and the
+      ``slo_budget_burn`` rule have a deterministic slow replica.
     - ``nan_batch_at_step``: once the host step counter reaches N, replace
       every floating leaf of ONE batch with NaN (:meth:`corrupt_batch`,
       fires once) — the NaN'd loss then arises through real training math,
@@ -305,7 +313,7 @@ class FaultInjector(object):
       ``M`` for ``S`` seconds after its first call, then 1.0.  Load
       generators poll it per request batch, so one env spec turns a
       steady drive into a surge that burns the latency SLO
-      (``latency_slo_burn`` -> remediator serving scale-out).
+      (``slo_budget_burn`` -> remediator serving scale-out).
     - ``drop_heartbeats_after``: heartbeat sender emits N beats, then goes
       silent while the process lives (tests missed-beat detection without a
       real death).
@@ -332,6 +340,7 @@ class FaultInjector(object):
         self._chunks = 0
         self._splits = 0
         self._slow_fired = False
+        self._slow_predict_fired = False
         self._consume_t0 = None   # first on_consume() (slow-drain anchor)
         self._consume_fired = False
         self._surge_t0 = None     # first traffic_multiplier() (surge anchor)
@@ -435,6 +444,22 @@ class FaultInjector(object):
             logger.warning("FaultInjector: slowing pid %d by %.3fs/step",
                            os.getpid(), delay)
             self._fired("sleep_per_step", delay_secs=delay, step=step)
+        time.sleep(delay)
+
+    def on_predict(self, rows=None, batch=None):
+        """Serving-plane hook (gateway ``_dispatch``, once per coalesced
+        batch): sleep ``sleep_per_predict_secs`` before the model dispatch,
+        making this replica a persistent straggler whose inflated
+        ``dispatch_us`` stage the request-plane observability must name."""
+        delay = self.spec.get("sleep_per_predict_secs")
+        if not delay:
+            return
+        if not self._slow_predict_fired:
+            self._slow_predict_fired = True
+            logger.warning("FaultInjector: slowing pid %d by %.3fs/predict",
+                           os.getpid(), delay)
+            self._fired("sleep_per_predict", delay_secs=delay, rows=rows,
+                        batch=batch)
         time.sleep(delay)
 
     def corrupt_batch(self, batch, step=None):

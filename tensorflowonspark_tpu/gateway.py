@@ -40,12 +40,28 @@ also advertises ``max_batch`` and the bucket ladder)::
 
 Metrics exported per beat (observatory renders ``_hwm``/``_max`` keys as
 gauges, everything else as ``_total`` counters): ``serving_requests``,
-``serving_rows``, ``serving_batches``, ``serving_shed``,
-``serving_compiles``, ``serving_p50_us_max``, ``serving_p99_us_max``,
-``serving_queue_depth_hwm``, ``serving_batch_fill_pct_max``.
+``serving_rows``, ``serving_batches``, ``serving_shed`` (plus the
+``serving_shed_<reason>`` split), ``serving_compiles``,
+``serving_p50_us_max``, ``serving_p99_us_max``, ``serving_queue_depth_hwm``,
+``serving_batch_fill_pct_max``.
+
+Request-plane observability (PR 19): every request carries a client-minted
+request id + telemetry flow id (``serving/request_flow``, riding the
+transport's ``K_TRACED`` header) so one slow request renders as a single
+cross-pid Perfetto arrow, and the gateway stamps each stage on a monotonic
+clock — ``queue_us`` (admission -> batch collection), ``coalesce_us``
+(collection -> dispatch start), ``dispatch_us`` (``predict_feed``),
+``serialize_us`` (slice + response write).  The four stage histograms plus
+the end-to-end ``serving_latency_us`` family ride heartbeats in the
+``STEP_MS_BUCKETS`` flat-counter convention, the worst requests are kept as
+exemplars (``slow_requests()``, the observatory's ``GET /slow``), and every
+completed-or-shed request is classified against ``slo_latency_us`` into the
+``serving_slo_good``/``serving_slo_total`` counters that feed watchtower's
+``slo_budget_burn`` multi-window budget math.
 """
 
 import collections
+import heapq
 import logging
 import socket
 import threading
@@ -53,6 +69,9 @@ import time
 
 import numpy as np
 
+from tensorflowonspark_tpu import fault
+from tensorflowonspark_tpu import metrics as metrics_mod
+from tensorflowonspark_tpu import telemetry
 from tensorflowonspark_tpu import transport
 from tensorflowonspark_tpu.transport import Transport, TransportError
 
@@ -61,6 +80,57 @@ logger = logging.getLogger(__name__)
 #: Latency samples kept for the p50/p99 window (enough for several beat
 #: intervals at saturation without unbounded growth).
 _LAT_WINDOW = 4096
+
+#: Worst-request exemplars kept in the bounded ring…
+_SLOW_RING = 32
+#: …and how many of those ride each heartbeat (the driver latch and /slow
+#: see the union across beats, so a small per-beat top-K is enough).
+_SLOW_BEAT = 8
+
+#: Typed shed reasons, also the ``reason=`` label set of
+#: ``tfos_serving_shed_total`` (emitted as zeros so scrapers see the full
+#: label space before the first shed).
+SHED_REASONS = ("overload", "deadline", "shutdown", "internal")
+
+
+class _Hist(object):
+    """Flat-counter latency histogram over microsecond bucket edges.
+
+    Same convention as the Trainer's ``step_ms_le_<bound>`` counters:
+    :meth:`flat` emits *cumulative* ``<prefix>_le_<bound>`` keys plus
+    ``_count``/``_sum_us``, which heartbeat latching, ``merge_counters``,
+    and the observatory's ``_render_histogram`` already know how to carry.
+    Callers hold the gateway's metrics lock around ``observe``.
+    """
+
+    __slots__ = ("buckets", "counts", "count", "sum_us")
+
+    def __init__(self, buckets=metrics_mod.SERVING_US_BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.count = 0
+        self.sum_us = 0
+
+    def observe(self, us):
+        self.count += 1
+        self.sum_us += int(round(us))
+        for i, bound in enumerate(self.buckets):
+            if us <= bound:
+                self.counts[i] += 1
+                return
+        # above the last edge: counted only in _count (the +Inf bucket)
+
+    def flat(self, prefix, out):
+        """Emit the flat-counter keys into ``out`` (skipped while empty so
+        idle replicas don't widen every heartbeat)."""
+        if not self.count:
+            return
+        running = 0
+        for bound, n in zip(self.buckets, self.counts):
+            running += n
+            out["{}_le_{}".format(prefix, bound)] = running
+        out[prefix + "_count"] = self.count
+        out[prefix + "_sum_us"] = self.sum_us
 
 
 class OverloadError(RuntimeError):
@@ -80,14 +150,18 @@ class OverloadError(RuntimeError):
 class _Request(object):
     """One queued prediction: feed columns plus completion callbacks."""
 
-    __slots__ = ("feed", "count", "deadline", "arrival",
-                 "on_result", "on_error")
+    __slots__ = ("feed", "count", "deadline", "arrival", "t_collect",
+                 "req_id", "flow", "on_result", "on_error")
 
-    def __init__(self, feed, count, deadline, on_result, on_error):
+    def __init__(self, feed, count, deadline, on_result, on_error,
+                 req_id=None, flow=0):
         self.feed = feed
         self.count = count
         self.deadline = deadline          # monotonic seconds, or None
         self.arrival = time.monotonic()
+        self.t_collect = None             # stamped when batched (queue end)
+        self.req_id = req_id              # client-minted request id string
+        self.flow = flow                  # serving/request_flow id, 0 = none
         self.on_result = on_result        # fn(outputs: {name: rows-slice})
         self.on_error = on_error          # fn(code, message)
 
@@ -106,7 +180,7 @@ class GatewayServer(object):
     def __init__(self, server, host="127.0.0.1", port=0, max_batch=None,
                  max_wait_ms=5.0, max_queue=None, roster_addr=None,
                  replica_id=None, task_index=0, heartbeat_interval=1.0,
-                 warmup=True):
+                 warmup=True, slo_latency_us=0.0, model_version=None):
         self.server = server
         self.host = host
         self.port = port
@@ -121,6 +195,18 @@ class GatewayServer(object):
         self.task_index = task_index
         self.heartbeat_interval = heartbeat_interval
         self._warmup = warmup
+        # SLO classification threshold: a completed request is "good" when
+        # its end-to-end latency is <= this many microseconds (0 disarms
+        # the latency leg: every completed request is good, only sheds
+        # burn budget).  Shed requests always count against the budget.
+        self.slo_latency_us = float(slo_latency_us or 0.0)
+        # model/version dimension, stubbed to one value until serving v2's
+        # multi-model fleet: ride heartbeats as string keys (merge_counters
+        # drops them from aggregates; the latch keeps them per-node).
+        desc = getattr(server, "descriptor", None) or {}
+        self.model = str(desc.get("model_name") or "default")
+        self.model_version = str(model_version
+                                 or desc.get("model_version") or "0")
 
         self._queue = collections.deque()
         self._cond = threading.Condition()
@@ -129,13 +215,27 @@ class GatewayServer(object):
         self._threads = []
         self._conns = set()
         self._hb = None
+        self._fault = fault.from_env()
 
         # counters (cumulative; heartbeat latch is latest-value-per-key)
         self.requests_total = 0
         self.rows_total = 0
         self.batches_total = 0
         self.shed_total = 0
+        self.shed_by_reason = {reason: 0 for reason in SHED_REASONS}
+        self.slo_good_total = 0
+        self.slo_total = 0
         self._lat_us = collections.deque(maxlen=_LAT_WINDOW)
+        self._stage_hists = {
+            "serving_queue_us": _Hist(),
+            "serving_coalesce_us": _Hist(),
+            "serving_dispatch_us": _Hist(),
+            "serving_serialize_us": _Hist(),
+            "serving_latency_us": _Hist(),
+        }
+        self._slow = []       # min-heap of (latency_us, seq, exemplar dict)
+        self._slow_seq = 0
+        self._req_seq = 0     # fallback ids for untagged/in-process entries
         self._queue_depth_hwm = 0
         self._batch_fill_pct = 0.0
         self._metrics_lock = threading.Lock()
@@ -207,6 +307,8 @@ class GatewayServer(object):
             pending = list(self._queue)
             self._queue.clear()
             self._cond.notify_all()
+        if pending:
+            self._count_shed("shutdown", len(pending))
         for req in pending:
             self._safe_error(req, "shutdown", "gateway stopping")
         if self._hb is not None:
@@ -246,11 +348,23 @@ class GatewayServer(object):
             raise box["err"]
         return box["out"]
 
-    def _enqueue(self, feed, count, deadline_ms, on_result, on_error):
+    def _count_shed(self, reason, n=1):
+        """One shed accounting point for every admission-control exit:
+        the total, the by-reason split, and the SLO budget (a shed request
+        is never a good request)."""
+        with self._metrics_lock:
+            self.shed_total += n
+            self.shed_by_reason[reason] = \
+                self.shed_by_reason.get(reason, 0) + n
+            self.slo_total += n
+
+    def _enqueue(self, feed, count, deadline_ms, on_result, on_error,
+                 req_id=None, flow=0):
         deadline = None
         if deadline_ms is not None:
             deadline = time.monotonic() + deadline_ms / 1000.0
-        req = _Request(feed, count, deadline, on_result, on_error)
+        req = _Request(feed, count, deadline, on_result, on_error,
+                       req_id=req_id, flow=flow)
         with self._cond:
             if self._stopped:
                 shed = ("shutdown", "gateway stopping")
@@ -260,15 +374,26 @@ class GatewayServer(object):
                             len(self._queue), self.max_queue))
             else:
                 shed = None
+                if req.req_id is None:
+                    self._req_seq += 1
+                    req.req_id = "{}-local-{}".format(self.replica_id,
+                                                      self._req_seq)
                 self._queue.append(req)
                 depth = len(self._queue)
                 if depth > self._queue_depth_hwm:
                     self._queue_depth_hwm = depth
                 self._cond.notify()
         if shed is not None:
-            with self._metrics_lock:
-                self.shed_total += 1
+            self._count_shed(shed[0])
+            if req.flow:
+                telemetry.get_tracer().flow_step(
+                    telemetry.SERVING_REQUEST_FLOW, req.flow,
+                    stage="shed", reason=shed[0], req=req.req_id)
             self._safe_error(req, *shed)
+        elif req.flow:
+            telemetry.get_tracer().flow_step(
+                telemetry.SERVING_REQUEST_FLOW, req.flow,
+                stage="admit", req=req.req_id, rows=int(req.count))
 
     def _batch_loop(self):
         """Continuous batcher: wait for the first request, then coalesce
@@ -283,6 +408,7 @@ class GatewayServer(object):
                     self._dispatch(batch)
                 except Exception as e:  # defensive: batcher must survive
                     logger.exception("gateway batch dispatch failed")
+                    self._count_shed("internal", len(batch))
                     for req in batch:
                         self._safe_error(req, "internal", repr(e))
 
@@ -306,6 +432,7 @@ class GatewayServer(object):
                                 and time.monotonic() > req.deadline):
                             expired.append(req)
                             continue
+                        req.t_collect = time.monotonic()  # queue stage ends
                         batch.append(req)
                         rows += req.count
                         if rows >= self.max_batch:
@@ -317,8 +444,7 @@ class GatewayServer(object):
         finally:
             # shed callbacks write to client sockets: never under the lock
             if expired:
-                with self._metrics_lock:
-                    self.shed_total += len(expired)
+                self._count_shed("deadline", len(expired))
                 for req in expired:
                     self._safe_error(
                         req, "deadline",
@@ -326,6 +452,7 @@ class GatewayServer(object):
                             (time.monotonic() - req.arrival) * 1e3))
 
     def _dispatch(self, batch):
+        tracer = telemetry.get_tracer()
         total = sum(r.count for r in batch)
         if len(batch) == 1:
             feed = batch[0].feed
@@ -333,8 +460,24 @@ class GatewayServer(object):
             keys = batch[0].feed.keys()
             feed = {k: np.concatenate([r.feed[k] for r in batch])
                     for k in keys}
-        outputs = self.server.predict_feed(feed, total)
-        now = time.monotonic()
+        # stage boundaries on one monotonic clock: [arrival, t_collect) is
+        # queue wait, [t_collect, t_d0) coalescing (incl. the concat above),
+        # [t_d0, t_d1) model dispatch, [t_d1, done_i) serialize — the four
+        # always sum exactly to the request's end-to-end latency.
+        t_d0 = time.monotonic()
+        # injected model slowness lands inside [t_d0, t_d1): it must show
+        # up as DISPATCH latency in the decomposition, like a real slow
+        # predict would
+        self._fault.on_predict(rows=total, batch=self.batches_total)
+        for req in batch:
+            if req.flow:
+                tracer.flow_step(telemetry.SERVING_REQUEST_FLOW, req.flow,
+                                 stage="dispatch", req=req.req_id,
+                                 batch_rows=int(total))
+        with tracer.span("serving/dispatch", rows=int(total),
+                         requests=len(batch)):
+            outputs = self.server.predict_feed(feed, total)
+        t_d1 = time.monotonic()
         from tensorflowonspark_tpu.serving import bucket_for
 
         fill = 100.0 * total / bucket_for(total, self.server.buckets)
@@ -343,8 +486,6 @@ class GatewayServer(object):
             self.requests_total += len(batch)
             self.rows_total += total
             self._batch_fill_pct = fill
-            for req in batch:
-                self._lat_us.append((now - req.arrival) * 1e6)
         lo = 0
         for req in batch:
             hi = lo + req.count
@@ -355,6 +496,64 @@ class GatewayServer(object):
             except Exception:
                 logger.debug("result callback failed (client gone?)",
                              exc_info=True)
+            done = time.monotonic()
+            self._account_request(req, total, t_d0, t_d1, done)
+            if req.flow:
+                tracer.flow_step(
+                    telemetry.SERVING_REQUEST_FLOW, req.flow,
+                    stage="serialize", req=req.req_id,
+                    e2e_us=int((done - req.arrival) * 1e6))
+
+    def _account_request(self, req, batch_rows, t_d0, t_d1, done):
+        """Per-request latency decomposition at completion: stage + e2e
+        histograms, the SLO classification, and the slow-exemplar ring."""
+        queue_us = (req.t_collect - req.arrival) * 1e6
+        coalesce_us = (t_d0 - req.t_collect) * 1e6
+        dispatch_us = (t_d1 - t_d0) * 1e6
+        serialize_us = (done - t_d1) * 1e6
+        e2e_us = (done - req.arrival) * 1e6
+        with self._metrics_lock:
+            self._lat_us.append(e2e_us)
+            hists = self._stage_hists
+            hists["serving_queue_us"].observe(queue_us)
+            hists["serving_coalesce_us"].observe(coalesce_us)
+            hists["serving_dispatch_us"].observe(dispatch_us)
+            hists["serving_serialize_us"].observe(serialize_us)
+            hists["serving_latency_us"].observe(e2e_us)
+            self.slo_total += 1
+            if self.slo_latency_us <= 0 or e2e_us <= self.slo_latency_us:
+                self.slo_good_total += 1
+            if (len(self._slow) < _SLOW_RING
+                    or e2e_us > self._slow[0][0]):
+                exemplar = {
+                    "req": req.req_id,
+                    "flow": int(req.flow or 0),
+                    "time": round(time.time(), 3),
+                    "latency_us": int(round(e2e_us)),
+                    "queue_us": int(round(queue_us)),
+                    "coalesce_us": int(round(coalesce_us)),
+                    "dispatch_us": int(round(dispatch_us)),
+                    "serialize_us": int(round(serialize_us)),
+                    "rows": int(req.count),
+                    "batch_rows": int(batch_rows),
+                    "model": self.model,
+                    "version": self.model_version,
+                }
+                item = (e2e_us, self._slow_seq, exemplar)
+                self._slow_seq += 1
+                if len(self._slow) < _SLOW_RING:
+                    heapq.heappush(self._slow, item)
+                else:
+                    heapq.heapreplace(self._slow, item)
+
+    def slow_requests(self, limit=None):
+        """The worst-latency exemplars seen so far (bounded ring of
+        :data:`_SLOW_RING`), slowest first — each a dict with the request
+        id, flow id, and the full stage breakdown."""
+        with self._metrics_lock:
+            worst = sorted(self._slow, reverse=True)
+        recs = [dict(rec) for _, _, rec in worst]
+        return recs[:limit] if limit else recs
 
     @staticmethod
     def _safe_error(req, code, message):
@@ -421,7 +620,22 @@ class GatewayServer(object):
                 # confirm a live autopilot retune landed
                 "serving_max_wait_ms_max": round(self.max_wait * 1e3, 3),
                 "serving_max_batch_max": self.max_batch,
+                # SLO error-budget feed for watchtower's slo_budget_burn
+                "serving_slo_good": self.slo_good_total,
+                "serving_slo_total": self.slo_total,
+                # model/version dimension (strings: latched per-node,
+                # dropped from merge_counters aggregates by design)
+                "serving_model": self.model,
+                "serving_model_version": self.model_version,
             }
+            for reason in SHED_REASONS:
+                out["serving_shed_" + reason] = \
+                    self.shed_by_reason.get(reason, 0)
+            for prefix, hist in self._stage_hists.items():
+                hist.flat(prefix, out)
+            if self._slow:
+                worst = sorted(self._slow, reverse=True)[:_SLOW_BEAT]
+                out["serving_slow"] = [dict(rec) for _, _, rec in worst]
         if lat:
             out["serving_p50_us_max"] = round(lat[len(lat) // 2], 1)
             out["serving_p99_us_max"] = round(
@@ -496,7 +710,11 @@ class GatewayServer(object):
 
     def _handle_predict(self, t, msg):
         rid = msg.get("id")
+        req_id = msg.get("req")
         kind, payload = t.recv_message()
+        flow = 0
+        if kind == transport.K_TRACED:
+            flow, kind, payload = Transport.split_traced(payload)
         columns, count, _ = Transport.decode_columns(kind, payload,
                                                      copy=False)
         names = msg.get("tensors") or [None] * len(columns)
@@ -512,17 +730,17 @@ class GatewayServer(object):
         def on_result(outputs):
             out_names = sorted(outputs)
             cols = [np.ascontiguousarray(outputs[n]) for n in out_names]
-            t.send_control({"type": "result", "id": rid,
+            t.send_control({"type": "result", "id": rid, "req": req_id,
                             "count": int(msg.get("count", count)),
                             "outputs": out_names})
             t.send_columns(cols, len(cols[0]) if cols else 0)
 
         def on_error(code, message):
-            t.send_control({"type": "error", "id": rid, "code": code,
-                            "message": message})
+            t.send_control({"type": "error", "id": rid, "req": req_id,
+                            "code": code, "message": message})
 
         self._enqueue(feed, count, msg.get("deadline_ms"),
-                      on_result, on_error)
+                      on_result, on_error, req_id=req_id, flow=flow)
 
 
 class GatewayChannel(object):
@@ -533,40 +751,69 @@ class GatewayChannel(object):
         self.addr = transport.addr_tuple(addr)
         sock = socket.create_connection(self.addr, timeout=timeout)
         sock.settimeout(timeout)
+        self.client_id = client_id or "gateway-client"
         self.transport = Transport(sock)
         reply = self.transport.client_hello(
-            extra={"client": client_id or "gateway-client"})
+            extra={"client": self.client_id})
         self.max_batch = reply.get("max_batch")
         self.buckets = reply.get("buckets")
         self.replica_id = reply.get("replica_id")
         self._next_id = 0
         self._lock = threading.Lock()
 
-    def predict(self, feed, count, deadline_ms=None):
+    def predict(self, feed, count, deadline_ms=None, request_id=None,
+                flow_id=None):
         """One round trip: ``feed`` is ``{tensor: array-like}`` with
         ``count`` leading rows; returns ``{name: np.ndarray}``.  Raises
         :class:`OverloadError` on a typed shed, EOFError/OSError when the
-        replica died (HA clients retry elsewhere)."""
+        replica died (HA clients retry elsewhere).
+
+        ``request_id``/``flow_id`` tag the request for cross-pid tracing;
+        when unset a request id is minted here and a flow id is minted from
+        the live tracer (0 — no trace header on the wire — when telemetry
+        is off).  The flow id rides the request frame's ``K_TRACED``
+        transport header so the gateway's admit/dispatch/serialize steps
+        join this client's flow arrow.
+        """
+        tracer = telemetry.get_tracer()
         names = sorted(feed)
         columns = [np.ascontiguousarray(np.asarray(feed[n]))
                    for n in names]
         with self._lock:
             self._next_id += 1
             rid = self._next_id
-            msg = {"type": "predict", "id": rid, "count": int(count),
-                   "tensors": names}
+            if request_id is None:
+                request_id = "{}-{}".format(self.client_id, rid)
+            if flow_id is None:
+                flow_id = tracer.new_flow_id()
+            msg = {"type": "predict", "id": rid, "req": request_id,
+                   "count": int(count), "tensors": names}
             if deadline_ms is not None:
                 msg["deadline_ms"] = float(deadline_ms)
-            self.transport.send_control(msg)
-            self.transport.send_columns(columns, int(count))
-            reply = self.transport.recv_control()
-            if reply.get("type") == "error":
-                raise OverloadError(reply.get("code", "error"),
-                                    reply.get("message", ""))
-            if reply.get("type") != "result":
-                raise TransportError("unexpected reply {!r}".format(reply))
-            kind, payload = self.transport.recv_message()
-            cols, _, _ = Transport.decode_columns(kind, payload, copy=True)
+            with tracer.span("serving/request", req=request_id,
+                             rows=int(count),
+                             replica=str(self.replica_id or "")):
+                if flow_id:
+                    tracer.flow_start(
+                        telemetry.SERVING_REQUEST_FLOW, flow_id,
+                        req=request_id,
+                        replica=str(self.replica_id or ""))
+                self.transport.send_control(msg)
+                self.transport.send_columns(columns, int(count),
+                                            flow_id=flow_id)
+                reply = self.transport.recv_control()
+                if reply.get("type") == "error":
+                    raise OverloadError(reply.get("code", "error"),
+                                        reply.get("message", ""))
+                if reply.get("type") != "result":
+                    raise TransportError(
+                        "unexpected reply {!r}".format(reply))
+                kind, payload = self.transport.recv_message()
+                cols, _, _ = Transport.decode_columns(kind, payload,
+                                                      copy=True)
+                if flow_id:
+                    tracer.flow_end(telemetry.SERVING_REQUEST_FLOW,
+                                    flow_id, req=request_id, stage="reply")
         return dict(zip(reply.get("outputs", []), cols))
 
     def ping(self):
@@ -609,6 +856,12 @@ class ServingClient(object):
         self._idx = 0
         self._chan = None
         self.failovers = 0
+        self._req_seq = 0
+        # client-side view of the wire: redials (transport failures that
+        # rotated replicas) and typed sheds the gateway handed back.  Flat
+        # counter names so callers can drop them onto any heartbeat.
+        self.counters = {"serving_client_redials": 0,
+                         "serving_client_shed": 0}
 
     @staticmethod
     def _discover(roster_addr, timeout):
@@ -650,21 +903,44 @@ class ServingClient(object):
             self._chan = None
         self._idx += 1
         self.failovers += 1
+        self.counters["serving_client_redials"] += 1
+        telemetry.get_tracer().counter_add("serving_client_redials")
 
     def predict(self, feed, count, deadline_ms=None):
         """Predict with failover: transport-level failures rotate to the
-        next replica, trying each one once before giving up."""
+        next replica, trying each one once before giving up.
+
+        The request id and ``serving/request_flow`` flow id are minted
+        ONCE here and re-sent verbatim on every failover attempt, so a
+        request that survived a replica kill still renders as one flow
+        arrow (with a visible hop to the second replica)."""
+        tracer = telemetry.get_tracer()
+        self._req_seq += 1
+        request_id = "{}-{}".format(self.client_id or "serving-client",
+                                    self._req_seq)
+        flow_id = tracer.new_flow_id()
         last = None
         for _ in range(len(self.replicas) + 1):
             try:
                 return self._channel().predict(feed, count,
-                                               deadline_ms=deadline_ms)
-            except OverloadError:
+                                               deadline_ms=deadline_ms,
+                                               request_id=request_id,
+                                               flow_id=flow_id)
+            except OverloadError as e:
+                self.counters["serving_client_shed"] += 1
+                tracer.counter_add("serving_client_shed")
+                if flow_id:
+                    tracer.flow_end(telemetry.SERVING_REQUEST_FLOW,
+                                    flow_id, req=request_id, stage="shed",
+                                    reason=e.code)
                 raise
             except (EOFError, OSError, ConnectionError,
                     TransportError) as e:
                 last = e
                 self._drop_channel()
+        if flow_id:
+            tracer.flow_end(telemetry.SERVING_REQUEST_FLOW, flow_id,
+                            req=request_id, stage="failed")
         raise ConnectionError(
             "predict failed on every replica: {!r}".format(last))
 

@@ -154,7 +154,8 @@ def serve_forever(args):
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         max_queue=args.max_queue, roster_addr=args.roster,
         replica_id=args.replica_id, task_index=args.task_index,
-        heartbeat_interval=args.heartbeat)
+        heartbeat_interval=args.heartbeat,
+        slo_latency_us=args.slo_latency_us)
     host, port = gw.start()
     print("serving replica {} ready on {}:{} (buckets {})".format(
         gw.replica_id, host, port, list(server.buckets)), flush=True)
@@ -163,6 +164,9 @@ def serve_forever(args):
         signal.signal(sig, lambda *_: done.set())
     done.wait()
     gw.stop()
+    # flush request-flow trace events before exit so a clean SIGTERM drain
+    # leaves trace-<host>-<pid>.json behind for the merged timeline
+    telemetry.get_tracer().flush()
 
 
 def main(argv=None):
@@ -207,6 +211,12 @@ def main(argv=None):
     serve.add_argument("--task-index", type=int, default=0, dest="task_index")
     serve.add_argument("--heartbeat", type=float, default=1.0,
                        help="roster heartbeat interval seconds")
+    serve.add_argument("--slo-latency-us", type=float, default=0.0,
+                       dest="slo_latency_us",
+                       help="availability+latency SLO threshold in "
+                            "microseconds: completed requests at or under "
+                            "it count as serving_slo_good (0 = latency "
+                            "leg disarmed; sheds always burn budget)")
     serve.add_argument("--warm-cache-dir", default=None,
                        dest="warm_cache_dir",
                        help="warm-start root: persistent XLA compile cache "

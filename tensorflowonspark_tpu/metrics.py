@@ -56,6 +56,15 @@ PEAK_BYTES_PER_SEC = {
 # sub-millisecond CPU toy step to a multi-second pathological stall.
 STEP_MS_BUCKETS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000)
 
+# Serving latency histogram bucket upper bounds, in microseconds.  Shared by
+# the gateway's request-plane accountant (``serving_*_us_le_<bound>``
+# heartbeat counters for the queue/coalesce/dispatch/serialize stages plus
+# the end-to-end ``serving_latency_us`` family) and the observatory's
+# Prometheus rendering, mirroring the STEP_MS_BUCKETS contract above.
+# Log-spaced from a 50us in-process hit to a 1s pathological stall.
+SERVING_US_BUCKETS = (50, 100, 250, 500, 1000, 2500, 5000, 10000, 25000,
+                      50000, 100000, 250000, 500000, 1000000)
+
 
 def achieved_flops_per_sec(step_flops, step_seconds):
     """Achieved per-device FLOP/s for one dispatch (None when unknowable)."""

@@ -37,10 +37,15 @@ cluster-level ``tfos_nodes``, ``tfos_scrapes_total``, and the windowed
 ``tfos_rate{key=...}`` gauges derived from the ring.  The serving
 gateway (PR 11) registers in the same roster under ``job_name="serving"``
 and exports through the same pipe: ``tfos_serving_requests_total`` /
-``_rows_total`` / ``_batches_total`` / ``_shed_total`` /
-``_compiles_total`` counters plus ``tfos_serving_p50_us_max`` /
-``_p99_us_max``, ``tfos_serving_queue_depth_hwm`` and
-``tfos_serving_batch_fill_pct_max`` gauges per replica.
+``_rows_total`` / ``_batches_total`` / ``_compiles_total`` counters, the
+``tfos_serving_shed_total{reason=}`` typed-shed family, the per-stage
+request-latency histograms (``tfos_serving_{queue,coalesce,dispatch,
+serialize,latency}_us``, each labeled ``model``/``version``), plus
+``tfos_serving_p50_us_max`` / ``_p99_us_max``,
+``tfos_serving_queue_depth_hwm`` and ``tfos_serving_batch_fill_pct_max``
+gauges per replica.  ``tfos_up{executor=}`` (from the roster's heartbeat
+ages) says which nodes are live, and ``GET /slow`` serves the fleet's
+worst-request exemplars with their stage breakdowns.
 """
 
 import json
@@ -57,7 +62,8 @@ from tensorflowonspark_tpu.metrics import STEP_MS_BUCKETS
 logger = logging.getLogger(__name__)
 
 __all__ = ["SampleRing", "render_prometheus", "ObservatoryServer",
-           "effective_window", "build_info", "DEFAULT_RING_CAPACITY"]
+           "effective_window", "build_info", "collect_slow",
+           "DEFAULT_RING_CAPACITY"]
 
 #: samples kept per node (at 1 s heartbeats: ~8.5 min of history)
 DEFAULT_RING_CAPACITY = 512
@@ -72,12 +78,65 @@ _NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
 # percentage/rate gauges which also use the _max suffix).
 _GAUGE_SUFFIXES = ("_hwm", "_max")
 
-# The Trainer's bucketed step-time histogram rides heartbeats as flat
-# cumulative counters; the renderer reassembles them into one Prometheus
-# histogram per executor.
+# Bucketed histograms ride heartbeats as flat cumulative counters
+# (``<prefix>_le_<bound>`` + ``<prefix>_count`` + ``<prefix>_sum_us``); the
+# renderer reassembles each family per executor.  Spec rows are
+# ``(key prefix, metric name, sum divisor, labeled with model/version?,
+# help text)`` — the Trainer's step-time histogram plus the serving
+# gateway's latency decomposition (PR 19).  Serving families carry the
+# ``model``/``version`` label dimension (stubbed to one value until the
+# multi-model fleet) read from the replica's ``serving_model`` /
+# ``serving_model_version`` heartbeat strings.
+_HISTOGRAMS = (
+    ("step_ms", "tfos_step_ms", 1000.0, False,
+     "Step wall time per dispatch, milliseconds."),
+    ("serving_queue_us", "tfos_serving_queue_us", 1.0, True,
+     "Serving stage: queue wait from admission to batch collection, "
+     "microseconds."),
+    ("serving_coalesce_us", "tfos_serving_coalesce_us", 1.0, True,
+     "Serving stage: batch coalescing from collection to dispatch start, "
+     "microseconds."),
+    ("serving_dispatch_us", "tfos_serving_dispatch_us", 1.0, True,
+     "Serving stage: model dispatch (predict_feed), microseconds."),
+    ("serving_serialize_us", "tfos_serving_serialize_us", 1.0, True,
+     "Serving stage: result slicing + response write, microseconds."),
+    ("serving_latency_us", "tfos_serving_latency_us", 1.0, True,
+     "End-to-end serving request latency, admission to response written, "
+     "microseconds."),
+)
+
+# Back-compat aliases (the step-time histogram predates the table above).
 _HIST_PREFIX = "step_ms_le_"
 _HIST_COUNT = "step_ms_count"
 _HIST_SUM_US = "step_ms_sum_us"
+
+# The typed shed split renders as one labeled family instead of four
+# metric names; the bare ``serving_shed`` total is skipped on /metrics so
+# sum(tfos_serving_shed_total) never double-counts.
+_SHED_KEY = re.compile(r"serving_shed_([a-z_]+)\Z")
+
+
+def _hist_spec_for(key):
+    """The ``_HISTOGRAMS`` row owning a flat counter key, or None."""
+    for spec in _HISTOGRAMS:
+        prefix = spec[0]
+        if (key.startswith(prefix + "_le_") or key == prefix + "_count"
+                or key == prefix + "_sum_us"):
+            return spec
+    return None
+
+
+def _model_labels(counters):
+    """``,model="...",version="..."`` label suffix for serving families,
+    from the replica's heartbeat strings (stubbed defaults otherwise)."""
+    model = counters.get("serving_model")
+    version = counters.get("serving_model_version")
+    if not isinstance(model, str) or not model:
+        model = "default"
+    if not isinstance(version, str) or not version:
+        version = "0"
+    return ',model="%s",version="%s"' % (_escape_label(model),
+                                         _escape_label(version))
 
 
 def _metric_name(key):
@@ -263,46 +322,71 @@ class _Families(object):
         return "\n".join(lines) + "\n"
 
 
-def _render_histogram(fams, executor, counters):
-    """Reassemble ``step_ms_le_*`` flat counters into a histogram family."""
+def _render_histogram(fams, executor, counters, spec, extra_labels=""):
+    """Reassemble one ``_HISTOGRAMS`` family's flat counters (cumulative
+    ``<prefix>_le_<bound>`` keys) into a Prometheus histogram."""
+    prefix, name, sum_divisor, _labeled, help_text = spec
+    le_prefix = prefix + "_le_"
     buckets = {}
     for key, val in counters.items():
-        if key.startswith(_HIST_PREFIX):
+        if key.startswith(le_prefix):
             try:
-                bound = float(key[len(_HIST_PREFIX):].replace("_", "."))
+                bound = float(key[len(le_prefix):].replace("_", "."))
             except ValueError:
                 continue
             buckets[bound] = val
-    count = counters.get(_HIST_COUNT)
+    count = counters.get(prefix + "_count")
     if not buckets and not count:
         return
-    name = "tfos_step_ms"
-    help_text = "Step wall time per dispatch, milliseconds."
     label = _escape_label(executor)
     cumulative = 0
     for bound in sorted(buckets):
         cumulative = buckets[bound]
         fams.add(name, "histogram", help_text,
-                 '%s_bucket{executor="%s",le="%s"} %s'
-                 % (name, label, _fmt_value(float(bound)),
+                 '%s_bucket{executor="%s"%s,le="%s"} %s'
+                 % (name, label, extra_labels, _fmt_value(float(bound)),
                     _fmt_value(buckets[bound])))
     inf_count = count if count is not None else cumulative
     fams.add(name, "histogram", help_text,
-             '%s_bucket{executor="%s",le="+Inf"} %s'
-             % (name, label, _fmt_value(inf_count)))
+             '%s_bucket{executor="%s"%s,le="+Inf"} %s'
+             % (name, label, extra_labels, _fmt_value(inf_count)))
     fams.add(name, "histogram", help_text,
-             '%s_count{executor="%s"} %s' % (name, label,
-                                             _fmt_value(inf_count)))
-    sum_us = counters.get(_HIST_SUM_US, 0)
+             '%s_count{executor="%s"%s} %s'
+             % (name, label, extra_labels, _fmt_value(inf_count)))
+    sum_us = counters.get(prefix + "_sum_us", 0)
     fams.add(name, "histogram", help_text,
-             '%s_sum{executor="%s"} %s' % (name, label,
-                                           _fmt_value(sum_us / 1000.0)))
+             '%s_sum{executor="%s"%s} %s'
+             % (name, label, extra_labels,
+                _fmt_value(sum_us / sum_divisor)))
+
+
+def collect_slow(snapshot, limit=None):
+    """Slow-request exemplars from a ``{"nodes": {id: counters}}``
+    metrics snapshot, slowest first.
+
+    Each serving replica rides its worst-request ring on heartbeats as the
+    ``serving_slow`` list (latched latest-per-node like every other key);
+    this flattens the per-node lists, tags each record with its executor,
+    and orders by end-to-end latency.  Shared by ``GET /slow`` and the
+    driver's ``tf_status`` latch so both views agree.
+    """
+    out = []
+    for executor in sorted((snapshot or {}).get("nodes") or {}):
+        counters = (snapshot["nodes"] or {}).get(executor)
+        if not isinstance(counters, dict):
+            continue
+        for rec in counters.get("serving_slow") or ():
+            if isinstance(rec, dict):
+                out.append(dict(rec, executor=str(executor)))
+    out.sort(key=lambda r: -(r.get("latency_us") or 0))
+    return out[:limit] if limit else out
 
 
 def render_prometheus(snapshot, ring=None, window_secs=60.0,
                       scrapes=None, alert_counts=None, info=None,
                       autopilot_counts=None, autopilot_ticks=None,
-                      remediation_counts=None, coordinator=None):
+                      remediation_counts=None, coordinator=None,
+                      beat_ages=None):
     """Prometheus text exposition (0.0.4) from one metrics snapshot.
 
     ``snapshot`` is the ``{"nodes": {id: counters}, "aggregate": {...}}``
@@ -316,7 +400,10 @@ def render_prometheus(snapshot, ring=None, window_secs=60.0,
     family plus ``tfos_autopilot_ticks_total``; ``remediation_counts``
     (``{action: {stage: n}}``, typically ``Remediator.action_counts``)
     the ``tfos_remediation_actions_total{action,stage}`` family; ``info``
-    (:func:`build_info`) the ``tfos_build_info`` gauge.
+    (:func:`build_info`) the ``tfos_build_info`` gauge; ``beat_ages``
+    (``{executor: secs}``, typically ``Server.beat_ages`` — fenced/dead
+    nodes already excluded) the ``tfos_up{executor=}`` liveness gauges,
+    so a scraper can tell a fenced node (0) from a quiet one (1).
     """
     nodes = (snapshot or {}).get("nodes") or {}
     fams = _Families()
@@ -332,6 +419,14 @@ def render_prometheus(snapshot, ring=None, window_secs=60.0,
     fams.add("tfos_nodes", "gauge",
              "Nodes currently contributing metric snapshots.",
              "tfos_nodes %d" % len(nodes))
+    if beat_ages is not None:
+        beating = {str(ex) for ex in beat_ages}
+        for ex in sorted(beating | {str(ex) for ex in nodes}):
+            fams.add("tfos_up", "gauge",
+                     "Executor liveness from roster heartbeat ages "
+                     "(1 = beating, 0 = fenced or gone silent).",
+                     'tfos_up{executor="%s"} %d'
+                     % (_escape_label(ex), 1 if ex in beating else 0))
     if scrapes is not None:
         fams.add("tfos_scrapes_total", "counter",
                  "Scrapes served by this observatory endpoint.",
@@ -406,14 +501,29 @@ def render_prometheus(snapshot, ring=None, window_secs=60.0,
         counters = nodes[executor]
         if not isinstance(counters, dict):
             continue
-        _render_histogram(fams, executor, counters)
+        model_labels = _model_labels(counters)
+        for spec in _HISTOGRAMS:
+            _render_histogram(fams, executor, counters, spec,
+                              extra_labels=model_labels if spec[3] else "")
         for key in sorted(counters):
             val = counters[key]
             if isinstance(val, bool) or not isinstance(val, (int, float)):
                 continue
-            if (key.startswith(_HIST_PREFIX) or key == _HIST_COUNT
-                    or key == _HIST_SUM_US):
-                continue  # folded into the histogram family above
+            if _hist_spec_for(key) is not None:
+                continue  # folded into a histogram family above
+            if key == "serving_shed":
+                continue  # superseded by the labeled by-reason split
+            shed = _SHED_KEY.match(key)
+            if shed:
+                fams.add("tfos_serving_shed_total", "counter",
+                         "Requests shed by gateway admission control, by "
+                         "typed reason.",
+                         'tfos_serving_shed_total{executor="%s",'
+                         'reason="%s"%s} %s'
+                         % (_escape_label(executor),
+                            _escape_label(shed.group(1)), model_labels,
+                            _fmt_value(val)))
+                continue
             if key.endswith(_GAUGE_SUFFIXES):
                 name = _metric_name(key)
                 mtype = "gauge"
@@ -457,7 +567,7 @@ class ObservatoryServer(object):
                  host="0.0.0.0", port=0, window_secs=60.0,
                  profile_fn=None, profiler_addresses_fn=None,
                  capture_status_fn=None, watchtower=None, autopilot=None,
-                 remediator=None, coordinator_fn=None):
+                 remediator=None, coordinator_fn=None, beat_ages_fn=None):
         """``profile_fn(duration_ms=, steps=)`` backs ``GET /profile``
         (typically ``CaptureCoordinator.trigger``; 503 when absent).
         ``profiler_addresses_fn`` / ``capture_status_fn`` enrich ``/status``
@@ -474,10 +584,13 @@ class ObservatoryServer(object):
         ``coordinator_fn`` (typically ``reservation.Server.ha_status``)
         backs the ``/status`` coordinator block and the
         ``tfos_coordinator_*`` metrics (fencing epoch, journal footprint,
-        takeover grace)."""
+        takeover grace).  ``beat_ages_fn`` (typically
+        ``reservation.Server.beat_ages``) backs the per-executor
+        ``tfos_up`` liveness gauges."""
         self._snapshot_fn = snapshot_fn
         self._status_fn = status_fn
         self._coordinator_fn = coordinator_fn
+        self._beat_ages_fn = beat_ages_fn
         self._profile_fn = profile_fn
         self._profiler_addresses_fn = profiler_addresses_fn
         self._capture_status_fn = capture_status_fn
@@ -536,6 +649,12 @@ class ObservatoryServer(object):
                 coordinator = self._coordinator_fn()
             except Exception:
                 coordinator = None
+        beat_ages = None
+        if self._beat_ages_fn is not None:
+            try:
+                beat_ages = self._beat_ages_fn()
+            except Exception:
+                beat_ages = None
         return render_prometheus(snapshot, ring=self.ring,
                                  window_secs=self._window_secs,
                                  scrapes=self._scrapes,
@@ -544,7 +663,35 @@ class ObservatoryServer(object):
                                  autopilot_counts=autopilot_counts,
                                  autopilot_ticks=autopilot_ticks,
                                  remediation_counts=remediation_counts,
-                                 coordinator=coordinator)
+                                 coordinator=coordinator,
+                                 beat_ages=beat_ages)
+
+    def _slow_json(self, query):
+        """``GET /slow``: the fleet's worst-request exemplars, slowest
+        first — each with its request id, flow id, and stage breakdown."""
+        import urllib.parse
+
+        params = urllib.parse.parse_qs(query or "")
+        try:
+            limit = int(params["limit"][0]) if params.get("limit") else 16
+        except ValueError:
+            return 400, json.dumps({"error": "limit must be an integer"})
+        try:
+            snapshot = self._snapshot_fn()
+        except Exception:
+            logger.warning("observatory: snapshot failed", exc_info=True)
+            snapshot = {}
+        try:
+            slow = collect_slow(snapshot)
+            payload = {
+                "time": time.time(),
+                "count": len(slow),
+                "slow": slow[:limit] if limit and limit > 0 else slow,
+            }
+        except Exception as e:
+            logger.exception("observatory: /slow failed")
+            return 500, json.dumps({"error": repr(e)})
+        return 200, json.dumps(payload, default=str)
 
     def _alerts_json(self, query):
         if self.watchtower is None:
@@ -733,9 +880,14 @@ class ObservatoryServer(object):
                     code, text = observatory._remediations_json(query)
                     body = text.encode("utf-8")
                     ctype = "application/json"
+                elif path in ("/slow", "/slow/"):
+                    code, text = observatory._slow_json(query)
+                    body = text.encode("utf-8")
+                    ctype = "application/json"
                 elif path == "/":
                     body = (b"tfos observatory: /metrics /status "
-                            b"/profile /alerts /autopilot /remediations\n")
+                            b"/profile /alerts /autopilot /remediations "
+                            b"/slow\n")
                     ctype = "text/plain; charset=utf-8"
                 else:
                     self.send_error(404)

@@ -28,7 +28,7 @@ action                 fired by                    machinery reused
                        saturation``/``cache_       worker`` subprocesses
                        thrash``                    (dynamic WREG; cache
                                                    affinity absorbs them)
-``scale_out_serving``  ``latency_slo_burn``        spawn a gateway
+``scale_out_serving``  ``slo_budget_burn``         spawn a gateway
                                                    replica behind the
                                                    roster (AOT-warmed)
 =====================  ==========================  =====================
@@ -82,7 +82,10 @@ RULE_ACTIONS = {
     "straggler_infeed": "evict_straggler",
     "dataservice_saturation": "scale_out_workers",
     "cache_thrash": "scale_out_workers",
+    # slo_budget_burn superseded latency_slo_burn (PR 19); the old name
+    # stays mapped so journal replays of earlier runs still resolve
     "latency_slo_burn": "scale_out_serving",
+    "slo_budget_burn": "scale_out_serving",
 }
 
 #: decision order within a tick: correctness before capacity

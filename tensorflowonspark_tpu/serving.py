@@ -412,6 +412,17 @@ class ModelServer(object):
                     sorted(self.signature) or "<unnamed>",
                     self.from_stablehlo)
 
+    @property
+    def model_name(self):
+        """Descriptor model name (the ``model`` label on serving metrics)."""
+        return str(self.descriptor.get("model_name") or "default")
+
+    @property
+    def model_version(self):
+        """Descriptor model version (the ``version`` label on serving
+        metrics) — stubbed to one value until multi-model serving v2."""
+        return str(self.descriptor.get("model_version") or "0")
+
     def _registry_predict(self):
         """Rebuild the apply fn from the model registry (the no-artifact
         fallback path)."""
@@ -647,6 +658,13 @@ class ModelServer(object):
         if bucket not in self._seen_buckets:
             self._seen_buckets.add(bucket)
             self.compile_count += 1
+            # a cold bucket on the serving path is a classic p99 culprit:
+            # mark it on the trace timeline next to the request flows
+            from tensorflowonspark_tpu import telemetry
+
+            telemetry.get_tracer().instant(
+                "serving/compile", bucket=int(bucket),
+                model=self.model_name)
         warm = self._warm_exec.get(bucket)
         if warm is not None:
             try:

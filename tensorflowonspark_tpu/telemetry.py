@@ -67,6 +67,14 @@ TELEMETRY_DIR_ENV = "TFOS_TELEMETRY_DIR"
 #: default max buffered events per process (each ~200 bytes serialized)
 DEFAULT_CAPACITY = 16384
 
+#: flow-event name for one serving request's journey — client predict ->
+#: gateway admission -> batch coalesce -> model dispatch -> response
+#: serialize.  The flow id is minted client-side (``ServingClient``) and
+#: rides the request frame's transport trace header (``transport.K_TRACED``)
+#: so Perfetto draws a single cross-pid arrow per request, the serving
+#: analogue of ``dataservice/split_flow``.
+SERVING_REQUEST_FLOW = "serving/request_flow"
+
 #: counter keys ending in one of these merge by ``max``; everything else sums
 _MAX_SUFFIXES = ("_hwm", "_max")
 

@@ -9,12 +9,15 @@ exact same colv1 frames as training chunks instead of a fifth bespoke
 protocol.
 
 Frame layout: 4-byte big-endian payload length + 1-byte kind byte,
-then the payload.  Three kinds:
+then the payload.  Four kinds:
 
 * ``K_JSON``   — UTF-8 JSON control message (hellos, acks, aborts),
 * ``K_COLV1``  — one ``wire.py`` colv1 columnar frame (zero-copy decode
   on receipt; optional per-column compression negotiated at hello),
-* ``K_PICKLE`` — pickled python payload, the object/ragged fallback.
+* ``K_PICKLE`` — pickled python payload, the object/ragged fallback,
+* ``K_TRACED`` — a :data:`THEADER` request-trace header (flow id +
+  reserved model/version tags) wrapping a K_COLV1/K_PICKLE payload, so
+  the serving request flow id rides the wire with its batch.
 
 The module level keeps the bare socket helpers (``recv_exact`` /
 ``recv_frame`` / ``send_frame`` / ``send_json`` / ``addr_tuple``) so
@@ -38,6 +41,17 @@ DHEADER = struct.Struct(">IB")
 K_JSON = 0     # UTF-8 JSON control message
 K_COLV1 = 1    # one wire.py colv1 frame (zero-copy decode on receipt)
 K_PICKLE = 2   # pickled payload (object/ragged fallback)
+K_TRACED = 3   # THEADER trace header + an inner K_COLV1/K_PICKLE payload
+
+# Request-plane trace header riding ahead of a columnar payload inside a
+# ``K_TRACED`` frame: u64 flow id (``telemetry.Tracer.new_flow_id``), u8
+# inner kind byte (K_COLV1 or K_PICKLE), then u16 model tag + u16 version
+# tag.  The tags are reserved and always 0 today — serving v2's multi-model
+# dimension rides in them without another frame-format bump.  Keeping the
+# trace header at the transport layer (not inside the colv1 fixed header)
+# means wire.py frames stay bit-identical with the data plane's, and a
+# request with no live tracer skips the wrapper entirely.
+THEADER = struct.Struct(">QBHH")
 
 
 class TransportError(RuntimeError):
@@ -170,10 +184,13 @@ class Transport(object):
         msg.update(fields)
         self.send_control(msg)
 
-    def send_columns(self, columns, count, tuple_rows=False):
+    def send_columns(self, columns, count, tuple_rows=False, flow_id=None):
         """Send one batch of columns: colv1 when framable, pickle fallback.
 
-        Returns the kind byte actually sent so callers can count formats.
+        A truthy ``flow_id`` wraps the payload in a ``K_TRACED`` frame so
+        the request's trace flow id travels with its data (one small-header
+        copy on the traced path only).  Returns the *inner* kind byte so
+        callers count formats the same with or without tracing.
         """
         kind = K_PICKLE
         payload = None
@@ -188,7 +205,11 @@ class Transport(object):
         if payload is None:
             payload = pickle.dumps((columns, count, tuple_rows),
                                    protocol=pickle.HIGHEST_PROTOCOL)
-        self._send(kind, payload)
+        if flow_id:
+            payload = THEADER.pack(int(flow_id), kind, 0, 0) + bytes(payload)
+            self._send(K_TRACED, payload)
+        else:
+            self._send(kind, payload)
         if kind == K_COLV1:
             self.colv1_sent += 1
         else:
@@ -231,10 +252,28 @@ class Transport(object):
         return msg
 
     @staticmethod
+    def split_traced(payload):
+        """Split a ``K_TRACED`` payload into ``(flow_id, inner_kind,
+        inner_payload)``.  The inner payload is a zero-copy memoryview into
+        ``payload``; the reserved model/version tags are discarded."""
+        if len(payload) < THEADER.size:
+            raise TransportError("traced frame shorter than THEADER")
+        flow_id, inner_kind, _model, _version = THEADER.unpack_from(
+            memoryview(payload), 0)
+        if inner_kind not in (K_COLV1, K_PICKLE):
+            raise TransportError(
+                "traced frame wraps kind={}".format(inner_kind))
+        return flow_id, inner_kind, memoryview(payload)[THEADER.size:]
+
+    @staticmethod
     def decode_columns(kind, payload, copy=False):
         """Decode a ``send_columns`` payload back to
         ``(columns, count, tuple_rows)``.  ``copy=False`` keeps colv1
-        columns as views pinning the receive buffer (zero-copy)."""
+        columns as views pinning the receive buffer (zero-copy).  A
+        ``K_TRACED`` frame decodes transparently (flow id discarded —
+        callers who want it use :meth:`split_traced` first)."""
+        if kind == K_TRACED:
+            _, kind, payload = Transport.split_traced(payload)
         if kind == K_COLV1:
             return wire.decode(payload, copy=copy)
         if kind == K_PICKLE:

@@ -107,12 +107,26 @@ DEFAULT_CONFIG = {
     # heartbeat-miss streak: newest sample older than interval * this
     # fires BEFORE the liveness fence (which waits heartbeat_misses beats)
     "heartbeat_miss_beats": 2.0,
-    # serving latency SLO: p99 objective in microseconds (0 disarms the
-    # rule — there is no universal latency target) and the fraction of
-    # in-window samples that must violate it before the burn alert fires
-    # (a lone spike is noise; sustained burn pages like a straggler does)
-    "latency_slo_p99_us": 0.0,
-    "latency_slo_burn_frac": 0.5,
+    # serving SLO error budget (slo_budget_burn): multi-window burn-rate
+    # alerting (the SRE-workbook shape) over each replica's cumulative
+    # serving_slo_good/serving_slo_total counters.  slo_objective is the
+    # good/total target fraction (e.g. 0.999; 0 disarms the rule — there
+    # is no universal objective); burn = windowed error rate / (1 -
+    # objective).  The rule PAGES (crit) when BOTH fast windows burn at
+    # >= slo_burn_fast and TICKETS (warn) when both slow windows burn at
+    # >= slo_burn_slow — the two-window AND is what survives traffic
+    # swings: a spike trips the short window but not the long one, a slow
+    # leak trips the long window while the short one has already calmed.
+    # Window pairs are (short, long) seconds; the engine keeps its own
+    # counter history sized to the longest window, so the sample ring's
+    # window_secs does not cap the budget math.  A window with fewer than
+    # slo_min_requests new requests abstains (no traffic != burning).
+    "slo_objective": 0.0,
+    "slo_fast_windows_secs": (300.0, 3600.0),
+    "slo_slow_windows_secs": (1800.0, 21600.0),
+    "slo_burn_fast": 14.4,
+    "slo_burn_slow": 6.0,
+    "slo_min_requests": 10,
     # alert plumbing
     "cooldown_secs": 30.0,
     "max_alerts": 256,
@@ -241,6 +255,11 @@ class RuleEngine(object):
         # remediator's confirm gate) can tell one-shot from sustained
         # without keeping their own streak state
         self._persist = {}
+        # SLO budget history: node -> [(ts, good, total), ...] newest-last.
+        # Engine state (not the sample ring) because the slow burn windows
+        # are hours while the ring holds ~8 minutes — and because replay
+        # must rebuild the identical history from journal snapshots.
+        self._slo_history = {}
         self.rules = (
             ("straggler_step_time", self._rule_straggler_step_time),
             ("straggler_dispatch_gap", self._rule_straggler_dispatch_gap),
@@ -250,7 +269,7 @@ class RuleEngine(object):
             ("infeed_starved", self._rule_infeed_starved),
             ("dataservice_saturation", self._rule_dataservice_saturation),
             ("cache_thrash", self._rule_cache_thrash),
-            ("latency_slo_burn", self._rule_latency_slo_burn),
+            ("slo_budget_burn", self._rule_slo_budget_burn),
             ("heartbeat_miss", self._rule_heartbeat_miss),
             ("coordinator_takeover", self._rule_coordinator_takeover),
         )
@@ -577,47 +596,117 @@ class RuleEngine(object):
                                  if spill_bytes else ""))))
         return alerts
 
-    def _rule_latency_slo_burn(self, window, now):
-        """Alert when a serving replica burns its latency SLO: at least
-        ``latency_slo_burn_frac`` of the in-window samples report a
-        ``serving_p50/p99`` window p99 (``serving_p99_us_max`` gauge) at or
-        above the ``latency_slo_p99_us`` objective.  Disarmed by default
-        (objective 0) — set the objective per deployment.  The alert
-        carries the window's shed count so the responder can tell
-        "overloaded and shedding" from "slow but admitting"."""
+    def _slo_window_burn(self, hist, now, window_secs, budget):
+        """Burn rate over the trailing ``window_secs`` of one node's
+        ``(ts, good, total)`` history: (bad delta / total delta) / budget.
+        Returns ``{"burn", "err_rate", "requests", "span_secs"}`` or None
+        when the window holds fewer than two points or fewer than
+        ``slo_min_requests`` new requests (abstain, never vote)."""
+        base = None
+        for point in hist:
+            if now - point[0] <= window_secs:
+                base = point
+                break
+        newest = hist[-1]
+        if base is None or base is newest:
+            return None
+        requests = newest[2] - base[2]
+        if requests < self.config["slo_min_requests"]:
+            return None
+        bad = requests - (newest[1] - base[1])
+        err_rate = bad / float(requests)
+        return {"burn": err_rate / budget,
+                "err_rate": err_rate,
+                "requests": requests,
+                "span_secs": newest[0] - base[0]}
+
+    def _rule_slo_budget_burn(self, window, now):
+        """Multi-window SLO error-budget burn (SRE workbook ch.5) over the
+        serving counters: every replica's cumulative ``serving_slo_good``
+        / ``serving_slo_total`` pair is folded into engine-held history,
+        and the burn rate — windowed error rate over the error budget
+        ``1 - slo_objective`` — is read over two window pairs.  Both fast
+        windows burning at >= ``slo_burn_fast`` is a PAGE (crit: the
+        budget dies in hours); both slow windows at >= ``slo_burn_slow``
+        is a TICKET (warn: a slow leak).  Disarmed by default
+        (``slo_objective`` 0).  The alert carries per-window evidence plus
+        the window's shed count so the responder can tell "overloaded and
+        shedding" from "slow but admitting"."""
         cfg = self.config
-        slo = cfg["latency_slo_p99_us"]
-        if not slo:
+        objective = cfg["slo_objective"]
+        if not objective:
             return []
+        budget = max(1.0 - float(objective), 1e-9)
+        fast_windows = tuple(cfg["slo_fast_windows_secs"])
+        slow_windows = tuple(cfg["slo_slow_windows_secs"])
+        max_window = max(fast_windows + slow_windows)
+        # fold the newest reading per in-window node into the history
+        for node, samples in window.items():
+            latest = samples[-1][1]
+            total = latest.get("serving_slo_total")
+            good = latest.get("serving_slo_good")
+            if not _finite(total):
+                continue
+            good = good if _finite(good) else 0
+            hist = self._slo_history.setdefault(node, [])
+            if hist and total < hist[-1][2]:
+                del hist[:]  # replica restarted with zeroed counters
+            if hist and now <= hist[-1][0]:
+                continue     # duplicate or backwards tick
+            hist.append((now, good, total))
+            cutoff = now - max_window
+            keep = 0
+            while (keep < len(hist) - 1 and hist[keep + 1][0] <= cutoff):
+                keep += 1
+            del hist[:keep]  # keep one point older than the longest window
         alerts = []
         for node, samples in window.items():
-            if len(samples) < cfg["min_samples"]:
+            hist = self._slo_history.get(node)
+            if not hist or len(hist) < 2:
                 continue
-            p99s = [m.get("serving_p99_us_max") for _, m in samples]
-            p99s = [v for v in p99s if _finite(v)]
-            if len(p99s) < cfg["min_samples"]:
+            fast = [self._slo_window_burn(hist, now, w, budget)
+                    for w in fast_windows]
+            slow = [self._slo_window_burn(hist, now, w, budget)
+                    for w in slow_windows]
+            page = all(b is not None and b["burn"] >= cfg["slo_burn_fast"]
+                       for b in fast)
+            ticket = all(b is not None and b["burn"] >= cfg["slo_burn_slow"]
+                         for b in slow)
+            if not page and not ticket:
                 continue
-            burning = sum(1 for v in p99s if v >= slo)
-            frac = burning / float(len(p99s))
-            if frac < cfg["latency_slo_burn_frac"]:
-                continue
+            which, windows_secs, threshold = (
+                (fast, fast_windows, cfg["slo_burn_fast"]) if page
+                else (slow, slow_windows, cfg["slo_burn_slow"]))
             d = window_deltas(samples)
             shed = (d["deltas"].get("serving_shed", 0) if d else 0)
+            windows_evidence = {
+                "{:g}s".format(w): {"burn": round(b["burn"], 3),
+                                    "err_rate": round(b["err_rate"], 5),
+                                    "requests": b["requests"]}
+                for w, b in zip(fast_windows + slow_windows, fast + slow)
+                if b is not None}
             alerts.append(self._alert(
-                "latency_slo_burn", now, executor=node, severity="warn",
-                value=round(frac, 3), threshold=cfg["latency_slo_burn_frac"],
-                p99_us=p99s[-1], slo_us=slo, shed=shed,
-                evidence={"p99_us": p99s[-1], "slo_us": slo,
-                          "burn_frac": round(frac, 3), "shed": shed,
-                          "span_secs": (round(d["span_secs"], 3)
-                                        if d else None),
-                          "requests_delta": (d["deltas"].get(
-                              "serving_requests", 0) if d else None),
-                          "batch_fill_pct": samples[-1][1].get(
-                              "serving_batch_fill_pct_max")},
-                message="replica {} burning latency SLO: p99 {:.0f}us >= "
-                        "{:.0f}us in {:.0%} of window samples ({} shed)"
-                        .format(node, p99s[-1], slo, frac, shed)))
+                "slo_budget_burn", now, executor=node,
+                severity="crit" if page else "warn",
+                value=round(min(b["burn"] for b in which), 3),
+                threshold=threshold,
+                kind="page" if page else "ticket",
+                objective=objective, shed=shed,
+                evidence={"objective": objective,
+                          "budget": round(budget, 6),
+                          "kind": "page" if page else "ticket",
+                          "windows": windows_evidence,
+                          "good": hist[-1][1], "total": hist[-1][2],
+                          "shed": shed},
+                message="replica {} burning SLO error budget ({}): "
+                        "{} over {} (objective {:.4%}, err rate "
+                        "{:.2%}, {} shed)".format(
+                            node, "page" if page else "ticket",
+                            " / ".join("{:.1f}x".format(b["burn"])
+                                       for b in which),
+                            " / ".join("{:g}s".format(w)
+                                       for w in windows_secs),
+                            objective, which[0]["err_rate"], shed)))
         return alerts
 
     def _rule_heartbeat_miss(self, window, now):
@@ -695,7 +784,7 @@ class Watchtower(object):
         the watchtower→autopilot bridge: ``cluster.run(autopilot=...)``
         wires ``Autopilot.observe_alert`` here, turning performance alerts
         (``infeed_starved``, ``dataservice_saturation``, ``cache_thrash``,
-        ``latency_slo_burn``) into timestamped retune hints the controller
+        ``slo_budget_burn``) into timestamped retune hints the controller
         may act on when its own window sensors are silent (see
         ``autopilot.ALERT_HINTS``).  The callback runs on the watchtower
         tick thread — keep it cheap.

@@ -298,3 +298,189 @@ def test_overload_is_not_retried_on_siblings(linear_export):
         client.close()
     finally:
         g.stop()
+
+
+# ---------------------------------------------------------------------------
+# request-plane observability: latency decomposition, shed reasons, SLO
+# accounting, the slow-exemplar ring, and the traced wire frame
+# ---------------------------------------------------------------------------
+
+def test_stage_histograms_decompose_e2e(gw):
+    for i in range(6):
+        gw.submit({"x": np.asarray([[float(i), 1.0]], np.float32)}, 1)
+    m = gw.heartbeat_metrics()
+    stages = ("serving_queue_us", "serving_coalesce_us",
+              "serving_dispatch_us", "serving_serialize_us")
+    # the four stage stamps are cuts of ONE monotonic interval: their sums
+    # re-add to the end-to-end sum exactly (modulo per-observe rounding)
+    total = sum(m[s + "_sum_us"] for s in stages)
+    e2e = m["serving_latency_us_sum_us"]
+    assert abs(total - e2e) <= 4 * m["serving_latency_us_count"]
+    for s in stages + ("serving_latency_us",):
+        assert m[s + "_count"] == 6
+        # cumulative buckets are monotone and bounded by _count
+        cum = [v for k, v in sorted(
+            ((k, v) for k, v in m.items()
+             if k.startswith(s + "_le_")),
+            key=lambda kv: float(kv[0].rsplit("_", 1)[1]))]
+        assert cum == sorted(cum)
+        assert not cum or cum[-1] <= m[s + "_count"]
+
+
+def test_shed_reasons_split_and_burn_budget(gw):
+    with pytest.raises(OverloadError):
+        gw.submit({"x": np.zeros((1, 2), np.float32)}, 1, deadline_ms=-1.0)
+    m = gw.heartbeat_metrics()
+    assert m["serving_shed"] == 1
+    assert m["serving_shed_deadline"] == 1
+    assert m["serving_shed_overload"] == 0
+    # a shed is an unavailable request: it burns SLO budget as a bad one
+    assert m["serving_slo_total"] == 1
+    assert m["serving_slo_good"] == 0
+
+
+def test_shutdown_shed_reason(linear_export):
+    server = serving.ModelServer(linear_export, batch_size=4)
+    g = GatewayServer(server, max_wait_ms=1.0)
+    # no start(): requests sit queued until the drain sheds them
+    codes = []
+    for _ in range(3):
+        g._enqueue({"x": np.zeros((1, 2), np.float32)}, 1, None,
+                   lambda out: None, lambda code, msg: codes.append(code))
+    g.stop()
+    assert codes == ["shutdown"] * 3
+    m = g.heartbeat_metrics()
+    assert m["serving_shed_shutdown"] == 3 and m["serving_shed"] == 3
+
+
+def test_overload_shed_reason(linear_export):
+    server = serving.ModelServer(linear_export, batch_size=4)
+    g = GatewayServer(server, max_wait_ms=1.0, max_queue=1)
+    g._enqueue({"x": np.zeros((1, 2), np.float32)}, 1, None,
+               lambda out: None, lambda code, msg: None)
+    errs = []
+    g._enqueue({"x": np.zeros((1, 2), np.float32)}, 1, None,
+               lambda out: None, lambda code, msg: errs.append(code))
+    assert errs == ["overload"]
+    assert g.heartbeat_metrics()["serving_shed_overload"] == 1
+
+
+def test_slo_classification_against_threshold(linear_export):
+    server = serving.ModelServer(linear_export, batch_size=4)
+    # a generous SLO: the request lands inside it
+    g = GatewayServer(server, max_wait_ms=1.0, slo_latency_us=60e6)
+    g.start()
+    try:
+        g.submit({"x": np.zeros((1, 2), np.float32)}, 1)
+        m = g.heartbeat_metrics()
+        assert (m["serving_slo_good"], m["serving_slo_total"]) == (1, 1)
+    finally:
+        g.stop()
+    # an absurd 0.001us SLO: the same request is a budget burn
+    g = GatewayServer(server, max_wait_ms=1.0, slo_latency_us=0.001)
+    g.start()
+    try:
+        g.submit({"x": np.zeros((1, 2), np.float32)}, 1)
+        m = g.heartbeat_metrics()
+        assert (m["serving_slo_good"], m["serving_slo_total"]) == (0, 1)
+    finally:
+        g.stop()
+
+
+def test_slow_ring_bounded_and_sorted(gw):
+    for i in range(40):
+        gw.submit({"x": np.asarray([[float(i), 1.0]], np.float32)}, 1)
+    recs = gw.slow_requests()
+    assert 0 < len(recs) <= 32          # the ring keeps the N worst only
+    lats = [r["latency_us"] for r in recs]
+    assert lats == sorted(lats, reverse=True)
+    for key in ("req", "flow", "time", "latency_us", "queue_us",
+                "coalesce_us", "dispatch_us", "serialize_us", "rows",
+                "batch_rows", "model", "version"):
+        assert key in recs[0]
+    assert recs[0]["req"].startswith(gw.replica_id)  # locally minted id
+    # heartbeats carry only the top slice, slowest-first
+    beat = gw.heartbeat_metrics()["serving_slow"]
+    assert len(beat) <= 8
+    assert [r["latency_us"] for r in beat] == lats[:len(beat)]
+    assert gw.slow_requests(limit=3) == recs[:3]
+
+
+def test_traced_frame_roundtrip():
+    a, b = socket.socketpair()
+    ta, tb = Transport(a), Transport(b)
+    try:
+        col = np.arange(12, dtype=np.float32).reshape(6, 2)
+        kind = ta.send_columns([col], 6, flow_id=0x5A5A5)
+        assert kind == transport.K_COLV1    # reports the INNER encoding
+        k, payload = tb.recv_message()
+        assert k == transport.K_TRACED
+        flow, inner, body = Transport.split_traced(payload)
+        assert flow == 0x5A5A5 and inner == transport.K_COLV1
+        cols, count, _ = Transport.decode_columns(inner, body)
+        assert count == 6
+        np.testing.assert_array_equal(cols[0], col)
+        # decode_columns also unwraps a whole traced frame transparently
+        # (receivers that don't care about the flow id keep working)
+        ta.send_columns([col], 6, flow_id=0x77)
+        k2, payload2 = tb.recv_message()
+        cols2, count2, _ = Transport.decode_columns(k2, payload2)
+        assert count2 == 6
+        np.testing.assert_array_equal(cols2[0], col)
+        # flow_id=0 (telemetry off) sends a plain untraced frame
+        ta.send_columns([col], 6, flow_id=0)
+        k3, _ = tb.recv_message()
+        assert k3 == transport.K_COLV1
+    finally:
+        ta.close()
+        tb.close()
+
+
+def test_split_traced_rejects_garbage():
+    with pytest.raises(TransportError):
+        Transport.split_traced(b"\x00\x01")     # shorter than the header
+    bad = transport.THEADER.pack(1, 99, 0, 0) + b"x"
+    with pytest.raises(TransportError):
+        Transport.split_traced(bad)             # unknown inner kind
+
+
+def test_request_flow_is_one_cross_stage_track(gw, tmp_path):
+    from tensorflowonspark_tpu import telemetry
+
+    telemetry.configure(True, str(tmp_path))
+    try:
+        ch = GatewayChannel((gw.host, gw.port))
+        try:
+            ch.predict({"x": np.asarray([[1.0, 1.0]], np.float32)}, 1)
+        finally:
+            ch.close()
+        # the reply is sent *before* the batcher thread emits its
+        # "serialize" flow step, so predict() returning does not mean the
+        # trace is complete — poll the (re-callable) flush until it lands
+        import glob
+        import json as json_mod
+
+        deadline = time.monotonic() + 5.0
+        while True:
+            telemetry.get_tracer().flush()
+            events = []
+            for path in glob.glob(str(tmp_path / "trace-*.json")):
+                with open(path) as f:
+                    events.extend(json_mod.load(f)["traceEvents"])
+            done_stages = {e["args"].get("stage") for e in events
+                           if e.get("cat") == "tfos_flow"
+                           and e.get("ph") == "t"}
+            if "serialize" in done_stages or time.monotonic() > deadline:
+                break
+            time.sleep(0.02)
+    finally:
+        telemetry.configure(False)
+    flow = [e for e in events if e.get("cat") == "tfos_flow"
+            and e.get("name") == "serving/request_flow"]
+    assert flow, "no request-flow events emitted"
+    ids = {e["id"] for e in flow}
+    assert len(ids) == 1                 # one request = one flow id
+    phases = {e["ph"] for e in flow}
+    assert phases == {"s", "t", "f"}     # start, steps, bound end
+    stages = {e["args"].get("stage") for e in flow if e["ph"] == "t"}
+    assert {"admit", "dispatch", "serialize"} <= stages

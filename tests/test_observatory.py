@@ -4,6 +4,7 @@ under node death, and runtime-MFU vs bench-MFU agreement (CPU mesh)."""
 import json
 import re
 import threading
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -292,3 +293,168 @@ class TestRuntimeMfuAgreement:
         runtime = snap["train_mfu_pct_max"] / 100.0
         assert stats["mfu"] / 2 <= runtime <= stats["mfu"] * 2, \
             (stats["mfu"], runtime)
+
+
+# ---------------------------------------------------------------------------
+# request-plane exposition: serving stage histograms, shed reasons, tfos_up,
+# and the /slow exemplar endpoint
+# ---------------------------------------------------------------------------
+
+SERVING_SNAPSHOT = {
+    "nodes": {
+        "replica-0": {
+            "serving_requests": 12, "serving_shed": 2,
+            "serving_shed_overload": 1, "serving_shed_deadline": 1,
+            "serving_shed_shutdown": 0, "serving_shed_internal": 0,
+            "serving_slo_good": 9, "serving_slo_total": 12,
+            "serving_model": "linear", "serving_model_version": "3",
+            "serving_queue_us_le_50": 2, "serving_queue_us_le_100": 7,
+            "serving_queue_us_le_250": 10, "serving_queue_us_count": 12,
+            "serving_queue_us_sum_us": 3100,
+            "serving_latency_us_le_500": 4, "serving_latency_us_le_1000": 11,
+            "serving_latency_us_count": 12,
+            "serving_latency_us_sum_us": 8800,
+            "serving_slow": [
+                {"req": "c0-4", "flow": 9, "latency_us": 900.0,
+                 "queue_us": 100.0, "coalesce_us": 50.0,
+                 "dispatch_us": 700.0, "serialize_us": 50.0,
+                 "rows": 1, "batch_rows": 4, "time": 1.0,
+                 "model": "linear", "version": "3"},
+                {"req": "c1-2", "flow": 11, "latency_us": 400.0,
+                 "queue_us": 40.0, "coalesce_us": 20.0,
+                 "dispatch_us": 320.0, "serialize_us": 20.0,
+                 "rows": 1, "batch_rows": 2, "time": 1.2,
+                 "model": "linear", "version": "3"},
+            ],
+        },
+        "replica-1": {
+            "serving_requests": 3,
+            "serving_slow": [
+                {"req": "c2-0", "flow": 21, "latency_us": 600.0,
+                 "queue_us": 50.0, "coalesce_us": 30.0,
+                 "dispatch_us": 500.0, "serialize_us": 20.0,
+                 "rows": 1, "batch_rows": 1, "time": 1.1,
+                 "model": "linear", "version": "3"}],
+        },
+    },
+    "aggregate": {"serving_requests": 15},
+}
+
+
+class TestServingExposition:
+    def test_stage_histogram_with_model_version_labels(self):
+        text = observatory.render_prometheus(SERVING_SNAPSHOT)
+        families, _ = _parse_exposition(text)
+        assert families["tfos_serving_queue_us"] == "histogram"
+        assert families["tfos_serving_latency_us"] == "histogram"
+        bucket_re = re.compile(
+            r'tfos_serving_queue_us_bucket\{executor="replica-0",'
+            r'model="linear",version="3",le="([^"]+)"\} (\d+)')
+        buckets = bucket_re.findall(text)
+        assert buckets and buckets[-1][0] == "+Inf"
+        counts = [int(c) for _, c in buckets]
+        assert counts == sorted(counts), "buckets not cumulative"
+        assert counts[-1] == 12
+        # sum divisor 1.0: microseconds survive as-is
+        assert ('tfos_serving_queue_us_sum{executor="replica-0",'
+                'model="linear",version="3"} 3100.0') in text
+        assert ('tfos_serving_queue_us_count{executor="replica-0",'
+                'model="linear",version="3"} 12') in text
+        # flat raw keys never leak as their own families
+        assert "serving_queue_us_le_50" not in families
+        assert "tfos_serving_queue_us_sum_us_total" not in families
+
+    def test_shed_reasons_become_one_labeled_family(self):
+        text = observatory.render_prometheus(SERVING_SNAPSHOT)
+        families, _ = _parse_exposition(text)
+        assert families["tfos_serving_shed_total"] == "counter"
+        for reason, val in (("overload", 1), ("deadline", 1),
+                            ("shutdown", 0), ("internal", 0)):
+            assert ('tfos_serving_shed_total{executor="replica-0",'
+                    'reason="%s",model="linear",version="3"} %d'
+                    % (reason, val)) in text
+        # the legacy unsplit serving_shed counter is superseded: it must
+        # not render as a second, double-counting family
+        assert re.search(
+            r'tfos_serving_shed_total\{executor="replica-0"\} ', text) \
+            is None
+
+    def test_slo_counters_render(self):
+        text = observatory.render_prometheus(SERVING_SNAPSHOT)
+        assert 'tfos_serving_slo_good_total{executor="replica-0"} 9' in text
+        assert 'tfos_serving_slo_total_total{executor="replica-0"} 12' \
+            in text
+        # the model/version strings ride heartbeats but are not numbers:
+        # they must never become sample lines
+        assert "serving_model" not in text
+
+    def test_tfos_up_liveness_gauge(self):
+        text = observatory.render_prometheus(
+            SERVING_SNAPSHOT, beat_ages={"replica-0": 0.2})
+        families, _ = _parse_exposition(text)
+        assert families["tfos_up"] == "gauge"
+        assert 'tfos_up{executor="replica-0"} 1' in text
+        # known to the snapshot but absent from beat_ages = fenced/silent
+        assert 'tfos_up{executor="replica-1"} 0' in text
+
+    def test_collect_slow_flattens_and_sorts(self):
+        slow = observatory.collect_slow(SERVING_SNAPSHOT)
+        assert [r["req"] for r in slow] == ["c0-4", "c2-0", "c1-2"]
+        assert [r["executor"] for r in slow] == \
+            ["replica-0", "replica-1", "replica-0"]
+        assert observatory.collect_slow(SERVING_SNAPSHOT, limit=1)[0][
+            "req"] == "c0-4"
+        assert observatory.collect_slow({}) == []
+
+
+class TestSlowEndpoint:
+    def test_slow_json_schema_limit_and_concurrency(self):
+        srv = observatory.ObservatoryServer(
+            lambda: SERVING_SNAPSHOT, host="127.0.0.1")
+        host, port = srv.start()
+        base = "http://%s:%d" % (host, port)
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                base + "/slow", timeout=5).read().decode())
+            assert set(doc) == {"time", "count", "slow"}
+            assert doc["count"] == 3
+            lats = [r["latency_us"] for r in doc["slow"]]
+            assert lats == sorted(lats, reverse=True)
+            for key in ("req", "flow", "latency_us", "queue_us",
+                        "coalesce_us", "dispatch_us", "serialize_us",
+                        "rows", "batch_rows", "model", "version",
+                        "executor"):
+                assert key in doc["slow"][0], key
+            # count stays the fleet total; limit truncates the list only
+            doc = json.loads(urllib.request.urlopen(
+                base + "/slow?limit=1", timeout=5).read().decode())
+            assert doc["count"] == 3 and len(doc["slow"]) == 1
+            assert doc["slow"][0]["req"] == "c0-4"
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + "/slow?limit=bogus",
+                                       timeout=5)
+            assert exc.value.code == 400
+
+            errs = []
+
+            def hammer():
+                try:
+                    for _ in range(10):
+                        d = json.loads(urllib.request.urlopen(
+                            base + "/slow", timeout=5).read().decode())
+                        assert d["count"] == 3
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            threads = [threading.Thread(target=hammer) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            assert not errs, errs
+            # the index advertises the endpoint
+            index = urllib.request.urlopen(
+                base + "/", timeout=5).read().decode()
+            assert "/slow" in index
+        finally:
+            srv.stop()

@@ -524,3 +524,131 @@ class TestFlightSources:
         tail = wt.ring_tail(depth=4)
         json.dumps(tail)   # NaN already stripped
         assert tail["0"][0][1] == {"loss": None, "chunks": 2}
+
+
+def _slo_beats(n, per_tick=100, good_frac=0.0, dt=1.0, t0=T0, start=0):
+    """Cumulative serving SLO counters: ``per_tick`` requests per beat of
+    which ``good_frac`` land inside the SLO."""
+    out = []
+    for i in range(start + 1, start + n + 1):
+        total = i * per_tick
+        out.append((t0 + i * dt, {
+            "serving_requests": total,
+            "serving_shed": 0,
+            "serving_slo_good": int(total * good_frac),
+            "serving_slo_total": total,
+        }))
+    return out
+
+
+SLO_CFG = {"slo_objective": 0.999,
+           "slo_fast_windows_secs": (4.0, 8.0),
+           "slo_slow_windows_secs": (6.0, 12.0),
+           "slo_min_requests": 10}
+
+
+class TestSloBudgetBurn:
+    def _drive(self, eng, series_by_node, ticks, dt=1.0):
+        """Feed growing prefixes tick by tick (engine history needs the
+        time axis); returns every slo alert minted along the way."""
+        out = []
+        for i in range(1, ticks + 1):
+            window = {n: s[:i] for n, s in series_by_node.items()}
+            out.extend(a for a in eng.evaluate(window, now=T0 + i * dt)
+                       if a["rule"] == "slo_budget_burn")
+        return out
+
+    def test_total_burn_pages_crit(self):
+        eng = watchtower.RuleEngine(SLO_CFG)
+        alerts = self._drive(eng, {"r0": _slo_beats(14, good_frac=0.0),
+                                   "r1": _slo_beats(14, good_frac=1.0)},
+                             ticks=14)
+        assert alerts, "100% err rate never paged"
+        assert {a["executor"] for a in alerts} == {"r0"}
+        a = alerts[-1]
+        # err rate 1.0 over a 0.1% budget: 1000x burn in BOTH fast windows
+        assert a["severity"] == "crit" and a["kind"] == "page"
+        assert a["value"] == pytest.approx(1000.0, rel=0.01)
+        assert a["threshold"] == watchtower.DEFAULT_CONFIG["slo_burn_fast"]
+        assert a["evidence"]["windows"]["4s"]["err_rate"] == 1.0
+        assert "page" in a["message"]
+
+    def test_slow_leak_tickets_warn(self):
+        # 1% err rate = 10x burn: over slo_burn_slow (6) but under
+        # slo_burn_fast (14.4) — a ticket, never a page
+        eng = watchtower.RuleEngine(SLO_CFG)
+        alerts = self._drive(
+            eng, {"r0": _slo_beats(14, per_tick=1000, good_frac=0.99)},
+            ticks=14)
+        assert alerts, "10x slow burn never ticketed"
+        a = alerts[-1]
+        assert a["severity"] == "warn" and a["kind"] == "ticket"
+        assert a["value"] == pytest.approx(10.0, rel=0.01)
+        assert a["threshold"] == watchtower.DEFAULT_CONFIG["slo_burn_slow"]
+
+    def test_disarmed_by_default(self):
+        # slo_objective defaults to 0: no objective, no budget, no rule
+        eng = watchtower.RuleEngine()
+        alerts = self._drive(eng, {"r0": _slo_beats(14, good_frac=0.0)},
+                             ticks=14)
+        assert alerts == []
+
+    def test_min_requests_abstains(self):
+        # 3 requests/tick never clears slo_min_requests=10 inside the 4s
+        # fast window pair before the run ends: abstain, never vote
+        eng = watchtower.RuleEngine(SLO_CFG)
+        alerts = self._drive(
+            eng, {"r0": _slo_beats(3, per_tick=3, good_frac=0.0)}, ticks=3)
+        assert alerts == []
+
+    def test_restart_reset_clears_history(self):
+        eng = watchtower.RuleEngine(SLO_CFG)
+        bad = _slo_beats(14, good_frac=0.0)
+        assert self._drive(eng, {"r0": bad}, ticks=14)
+        # the replica restarts: cumulative counters drop to near zero
+        restarted = _slo_beats(1, per_tick=5, good_frac=1.0,
+                               t0=T0 + 14.0)
+        post = [a for a in eng.evaluate({"r0": restarted}, now=T0 + 15.0)
+                if a["rule"] == "slo_budget_burn"]
+        assert post == []          # pre-restart badness must not carry over
+        assert len(eng._slo_history["r0"]) == 1
+
+    def test_replay_rederives_slo_verdicts(self, tmp_path):
+        ring = observatory.SampleRing()
+        latest = {}
+
+        def snapshot_fn():
+            return {"nodes": {n: dict(c) for n, c in latest.items()},
+                    "aggregate": {}}
+
+        clock = {"now": T0}
+        jpath = os.path.join(str(tmp_path), "slo_journal.jsonl")
+        wt = watchtower.Watchtower(
+            ring=ring, snapshot_fn=snapshot_fn,
+            config=dict(SLO_CFG, cooldown_secs=5.0,
+                        journal_snapshot_secs=1.0, interval_secs=3600.0,
+                        slo_min_requests=5),
+            journal_path=jpath, clock=lambda: clock["now"])
+        wt.start()
+        burning = _slo_beats(12, good_frac=0.0)
+        healthy = _slo_beats(12, good_frac=1.0)
+        for i in range(12):
+            clock["now"] = T0 + i + 1
+            for node, beats in (("r0", burning), ("r1", healthy)):
+                ts, c = beats[i]
+                ring.record(node, c, ts=ts)
+                latest[node] = c
+            wt.tick(now=clock["now"])
+        wt.stop()
+        live = {(a["rule"], a["executor"]) for a in wt.alerts()
+                if a["rule"] == "slo_budget_burn"}
+        assert live == {("slo_budget_burn", "r0")}
+
+        result = watchtower.replay_journal(watchtower.read_journal(jpath))
+        journaled = {(a["rule"], a["executor"])
+                     for a in result["journaled_alerts"]
+                     if a["rule"] == "slo_budget_burn"}
+        replayed = {(a["rule"], a["executor"]) for a in result["alerts"]
+                    if a["rule"] == "slo_budget_burn"}
+        assert journaled == live
+        assert replayed == live

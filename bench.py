@@ -95,6 +95,7 @@ LEG_TIMEOUT_SECS = {"mnist": 1500, "resnet": 1800, "transformer": 1800,
                     "dataservice_cached_epoch": 300,
                     "shared_jobs": 300,
                     "serving_latency": 300,
+                    "multi_model_fleet": 240,
                     "warm_start": 600,
                     "autopilot_convergence": 300}
 
@@ -946,6 +947,175 @@ def measure_serving_latency(points=(1, 8, 32), secs_per_point=1.2,
     }
 
 
+def measure_multi_model_fleet(clients_per_model=2, secs_phase=1.2,
+                              width=256):
+    """Model-fleet serving: aggregate throughput across a multi-model
+    router with a live version swap landing mid-traffic.
+
+    Three fleet-named models (alpha/beta/gamma — registry identities, all
+    computing through the registered ``linear`` architecture) each get one
+    gateway replica; ``clients_per_model`` closed-loop FleetClients per
+    model route through one shared :class:`fleet.FleetRouter`.  Halfway
+    through, beta's replica is flipped to a new weight version via the
+    ``serving_load_version`` heartbeat knob — the fleet's zero-recompile
+    swap path — while every client keeps firing.  Constant-valued kernels
+    (``c * ones``) make every answer numerically traceable: a row summing
+    to S must come back as ``c_version * S``, so a single tolerance check
+    proves no request was served torn weights.  Headline numbers:
+    aggregate completed QPS across the fleet, the post/pre-swap p99 ratio
+    (a flat ratio means the swap is invisible to clients), and compiles
+    after warmup through the swap (must be 0: weight flips reuse the warm
+    programs)."""
+    import threading
+
+    from tensorflowonspark_tpu import checkpoint, fleet, gateway, serving
+
+    tmp = tempfile.mkdtemp()
+    # constant kernels: model m at version v answers c * sum(x)
+    coef = {("alpha", "1"): 0.001, ("beta", "1"): 0.002,
+            ("gamma", "1"): 0.003, ("beta", "2"): 0.004}
+
+    def export(model, version):
+        path = os.path.join(tmp, "{}-{}".format(model, version))
+        c = coef[(model, version)]
+        params = {"dense": {
+            "kernel": np.full((width, width), c, np.float32),
+            "bias": np.zeros((width,), np.float32)}}
+        checkpoint.export_model(
+            path, params, model,
+            model_config={"architecture": "linear", "features": width},
+            input_signature={"x": [None, width]})
+        return path
+
+    models = ("alpha", "beta", "gamma")
+    exports = {key: export(*key) for key in coef}
+    servers = {m: serving.ModelServer(exports[(m, "1")], batch_size=16)
+               for m in models}
+    gws = {m: gateway.GatewayServer(servers[m], max_batch=16,
+                                    max_wait_ms=0.25,
+                                    max_queue=clients_per_model * 8,
+                                    model_version="1",
+                                    replica_id="bench-{}".format(m))
+           for m in models}
+    router = fleet.FleetRouter()
+    stop = threading.Event()
+    lock = threading.Lock()
+    samples, errors = [], []
+    sheds = [0]
+    try:
+        for m in models:
+            host, port = gws[m].start()
+            router.register_replica("bench-{}".format(m),
+                                    "{}:{}".format(host, port), m, "1")
+
+        # warm every model's dispatch path before the compile baseline
+        warm_client = fleet.FleetClient(router, timeout=30.0)
+        for m in models:
+            warm_client.predict(
+                m, {"x": np.zeros((1, width), np.float32)}, 1)
+        warm_client.close()
+        compiles0 = {m: servers[m].compile_count for m in models}
+
+        def worker(model, seed):
+            client = fleet.FleetClient(router, timeout=30.0)
+            rng = np.random.default_rng(seed)
+            mine = []
+            try:
+                while not stop.is_set():
+                    x = rng.random((1, width), dtype=np.float32)
+                    t0 = time.perf_counter()
+                    try:
+                        got = client.predict(model, {"x": x}, 1)
+                    except gateway.OverloadError:
+                        with lock:
+                            sheds[0] += 1
+                        time.sleep(0.001)
+                        continue
+                    lat_us = (time.perf_counter() - t0) * 1e6
+                    mine.append((model, time.time(), lat_us,
+                                 float(x.sum()),
+                                 float(np.asarray(got["output"])[0][0])))
+            except Exception as e:  # any loss/corruption lands here
+                with lock:
+                    errors.append("{}: {!r}".format(model, e))
+            finally:
+                client.close()
+                with lock:
+                    samples.extend(mine)
+
+        threads = [threading.Thread(target=worker, args=(m, 7 * i + 1),
+                                    daemon=True)
+                   for i, m in enumerate(models * clients_per_model)]
+        t_start = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(secs_phase)
+
+        # mid-traffic live swap: beta -> v2 over the heartbeat knob path
+        t_swap = time.time()
+        gws["beta"]._on_beat_reply({"knobs": {"serving_load_version": {
+            "model": "beta", "version": "2",
+            "export_dir": exports[("beta", "2")],
+            "token": "bench-beta-2"}}})
+        deadline = time.time() + 30.0
+        while gws["beta"].model_version != "2" and time.time() < deadline:
+            time.sleep(0.005)
+        swap_secs = time.time() - t_swap
+        applied = gws["beta"].model_version == "2"
+        router.note_version("bench-beta", "2")
+
+        time.sleep(secs_phase)
+        stop.set()
+        for t in threads:
+            t.join(timeout=secs_phase + 30.0)
+        elapsed = max(time.time() - t_start, 1e-9)
+    finally:
+        stop.set()
+        for m in models:
+            gws[m].stop()
+
+    if errors:
+        raise RuntimeError("fleet clients failed: {}".format(errors[:3]))
+    if not applied:
+        raise RuntimeError("beta swap never applied")
+
+    # every answer must match EXACTLY one published version's constant
+    tol = 1e-2
+    for model, _t, _lat, xsum, got in samples:
+        ok = any(abs(got - coef[(mm, vv)] * xsum) < tol
+                 for (mm, vv) in coef if mm == model)
+        if not ok:
+            raise RuntimeError(
+                "answer from no published version: {} got {} (sum {})"
+                .format(model, got, xsum))
+
+    def p99(rows):
+        lat = sorted(r[2] for r in rows)
+        return (round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 1)
+                if lat else None)
+
+    pre = [r for r in samples if r[1] < t_swap]
+    post = [r for r in samples if r[1] >= t_swap + swap_secs]
+    per_model = {m: round(sum(1 for r in samples if r[0] == m) / elapsed, 1)
+                 for m in models}
+    p99_pre, p99_post = p99(pre), p99(post)
+    return {
+        "models": len(models),
+        "aggregate_qps": round(len(samples) / elapsed, 1),
+        "per_model_qps": per_model,
+        "p99_us_pre_swap": p99_pre,
+        "p99_us_post_swap": p99_post,
+        "swap_p99_ratio": (round(p99_post / max(p99_pre, 1e-9), 2)
+                           if p99_pre and p99_post else None),
+        "swap_apply_secs": round(swap_secs, 3),
+        "compiles_after_warmup": sum(
+            servers[m].compile_count - compiles0[m] for m in models),
+        "beta_swaps_total": gws["beta"].swaps_total,
+        "sheds_retried": sheds[0],
+        "answers_checked": len(samples),
+    }
+
+
 # The warm-start child: one "node lifetime" in a fresh interpreter — point
 # the compile plane at the shared root, build a Trainer over the AOT store,
 # pay (or skip) the compile, report the debt.  Run twice against one root
@@ -1200,6 +1370,7 @@ _LEGS = {
     "dataservice_cached_epoch": measure_dataservice_cached_epoch,
     "shared_jobs": measure_shared_jobs,
     "serving_latency": measure_serving_latency,
+    "multi_model_fleet": measure_multi_model_fleet,
     "warm_start": measure_warm_start,
     "autopilot_convergence": measure_autopilot_convergence,
 }
@@ -1525,6 +1696,7 @@ def main():
     dscache, dscache_err = run_leg_isolated("dataservice_cached_epoch")
     shared, shared_err = run_leg_isolated("shared_jobs")
     servlat, servlat_err = run_leg_isolated("serving_latency")
+    mmfleet, mmfleet_err = run_leg_isolated("multi_model_fleet")
     warmstart, warmstart_err = run_leg_isolated("warm_start")
     pilot, pilot_err = run_leg_isolated("autopilot_convergence")
     # The transformer leg runs LAST — after every graded leg,
@@ -1709,6 +1881,19 @@ def main():
             "compiles_after_warmup")
     elif servlat_err:
         out["serving_latency_error"] = servlat_err
+    if mmfleet:
+        # model fleet: aggregate completed QPS across the 3-model router,
+        # the client-observed p99 ratio across the mid-run live swap (flat
+        # ratio == swap invisible to clients), and the compile-flatness
+        # proof through the weight flip
+        out["fleet_aggregate_qps"] = mmfleet.get("aggregate_qps")
+        out["fleet_swap_p99_ratio"] = mmfleet.get("swap_p99_ratio")
+        out["fleet_p99_us"] = mmfleet.get("p99_us_post_swap")
+        out["fleet_swap_apply_secs"] = mmfleet.get("swap_apply_secs")
+        out["fleet_compiles_after_swap"] = mmfleet.get(
+            "compiles_after_warmup")
+    elif mmfleet_err:
+        out["multi_model_fleet_error"] = mmfleet_err
     if warmstart:
         # warm-start compile plane: the compile debt (canonical-program
         # wall + explicit AOT lower/compile) a restarted node pays over a
@@ -1789,6 +1974,7 @@ def main():
         "dataservice_cached_epoch": (dscache or {}).get("value_source"),
         "shared_jobs": (shared or {}).get("value_source"),
         "serving_latency": (servlat or {}).get("value_source"),
+        "multi_model_fleet": (mmfleet or {}).get("value_source"),
         "warm_start": (warmstart or {}).get("value_source"),
         "autopilot_convergence": (pilot or {}).get("value_source"),
     }

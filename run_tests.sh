@@ -92,7 +92,18 @@ python -m pytest tests/ -q --durations=10 "$@" || rc=$?
 # histograms re-add to the e2e sum on /metrics, /slow names the stalled
 # requests by client-minted id, slo_budget_burn pages the slow replica
 # only, the merged timeline stitches cross-process request flows, and
-# metrics_replay.py re-derives the identical verdicts from the journal
+# metrics_replay.py re-derives the identical verdicts from the journal,
+# and finally prove the model fleet holds: a 3-model registry-resolved
+# fleet (2 replicas each) under concurrent multi-model clients sees a
+# poisoned beta@2 (finite params, overflowing matmuls) canaried onto one
+# replica and auto-rolled-back off the version-labeled nonfinite signal,
+# then a real fit_supervised run publishes beta@3 through the
+# train-to-serve handoff and the canary controller walks it to live on
+# every replica — zero accepted requests lost, every answer numerically
+# traceable to a published version, serving_compiles flat through both
+# swaps (weight flips never recompile), client p99 flat, /fleet serving
+# the control-plane state, and fleet.replay_journal re-deriving the
+# exact promote/rollback stream from the canary journal
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 python scripts/ci_assert_elastic.py
 python scripts/ci_assert_telemetry.py
@@ -110,5 +121,6 @@ python scripts/ci_assert_ha.py
 python scripts/ci_assert_megastep.py
 python scripts/ci_assert_remediator.py
 python scripts/ci_assert_reqtrace.py
+python scripts/ci_assert_fleet.py
 
 exit $rc

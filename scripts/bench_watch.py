@@ -323,6 +323,14 @@ HEADLINE_METRICS = (
     ("resnet50_steps_per_call", "resnet", "higher"),
     ("transformer_lm_steps_per_call", "transformer", "higher"),
     ("mnist_steps_per_call", "mnist", "higher"),
+    # model fleet (absent pre-round-20, skipped by run_diff): aggregate
+    # QPS across the 3-model router, the p99 ratio across the mid-run
+    # live swap (1.0 == the swap is invisible to clients), and the
+    # compile count through the weight flip ("lower" — any nonzero means
+    # a swap retraced a program it should have reused)
+    ("fleet_aggregate_qps", "multi_model_fleet", "higher"),
+    ("fleet_swap_p99_ratio", "multi_model_fleet", "lower"),
+    ("fleet_compiles_after_swap", "multi_model_fleet", "lower"),
 )
 
 

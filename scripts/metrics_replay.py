@@ -28,6 +28,12 @@ journaled watchtower alerts re-feed the action plane's decision logic
 dry, the live proposed→applied→effect→kept/reverted topology-action
 stream is printed, and the live-vs-replay proposal divergence reported.
 
+A **fleet canary** journal (the ``CanaryController`` stream) replays
+through :func:`tensorflowonspark_tpu.fleet.replay_journal`: the SAME
+window-judgement math re-runs over the journaled per-tick samples, so
+every promotion (``kept``) and rollback (``reverted``) decision is
+re-derived from the recorded evidence, not just read back.
+
 Usage:
   python scripts/metrics_replay.py <journal.jsonl>            # human report
   python scripts/metrics_replay.py <journal.jsonl> --json     # machine doc
@@ -66,16 +72,19 @@ def _fmt(v):
 
 
 def detect_kind(records):
-    """``"autopilot"``, ``"remediator"``, or ``"watchtower"`` from the
-    journal's own records: the autopilot meta carries a ``knobs`` map, the
-    remediator meta a ``families`` list; the watchtower meta has neither
-    and its stream is ``alert`` records."""
+    """``"autopilot"``, ``"remediator"``, ``"fleet"``, or ``"watchtower"``
+    from the journal's own records: the autopilot meta carries a ``knobs``
+    map, the remediator meta a ``families`` list, the fleet canary meta a
+    ``canary`` marker; the watchtower meta has none of them and its
+    stream is ``alert`` records."""
     for rec in records:
         if rec.get("kind") == "meta":
             if "knobs" in rec:
                 return "autopilot"
             if "families" in rec:
                 return "remediator"
+            if rec.get("canary"):
+                return "fleet"
             return "watchtower"
     for rec in records:
         if rec.get("kind") == "action":
@@ -230,6 +239,62 @@ def remediator_report(args, records, overrides):
     return 0
 
 
+def fleet_report(args, records, overrides):
+    from tensorflowonspark_tpu import fleet
+
+    result = fleet.replay_journal(records, config=overrides)
+    derived, journaled = result["decisions"], result["journaled"]
+    samples = sum(1 for r in records if r.get("kind") == "sample")
+    stages = [r for r in records if r.get("kind") == "stage"]
+
+    if args.json:
+        json.dump({"kind": "fleet", "journal": args.journal,
+                   "samples": samples, "config": result["config"],
+                   "journaled_decisions": [list(d) for d in journaled],
+                   "replayed_decisions": [list(d) for d in derived],
+                   "matches": result["matches"]}, sys.stdout, default=str)
+        print()
+        return 0 if samples else 2
+
+    print("journal: %s (fleet canary)" % args.journal)
+    print("sample records: %d, stage records: %d, journaled decisions: %d, "
+          "re-derived decisions: %d"
+          % (samples, len(stages), len(journaled), len(derived)))
+    t0 = min((r.get("time", 0.0) for r in records
+              if r.get("kind") in ("sample", "stage")), default=0.0)
+    if stages:
+        print("\nlive canary stream:")
+        for rec in stages:
+            extra = ""
+            if rec.get("stage") == "reverted":
+                extra = "  reason=%s -> %s" % (rec.get("reason"),
+                                               rec.get("rolled_back_to"))
+            elif rec.get("stage") == "applied":
+                extra = "  split=%s" % (rec.get("split"),)
+            print("  [t+%7.1fs] %-9s %s@%s replica=%s%s"
+                  % (rec.get("time", 0.0) - t0, rec.get("stage"),
+                     rec.get("model"), rec.get("version"),
+                     rec.get("replica", "-"), extra))
+    else:
+        print("\nno canary stages journaled by the live run")
+    if derived:
+        print("\nre-derived decisions (window judgement re-run over the "
+              "journaled samples):")
+        for stage, model, version in derived:
+            print("  %-9s %s@%s" % (stage, model, version))
+    else:
+        print("\nno decisions re-derived from the samples")
+    if result["matches"]:
+        print("\nlive and replay decision streams agree")
+    else:
+        print("\nDIVERGENCE: journaled %s vs re-derived %s"
+              % (journaled, derived))
+    if not samples:
+        print("no sample records: nothing to evaluate", file=sys.stderr)
+        return 2
+    return 0 if result["matches"] else 1
+
+
 def build_timeline(records, result, keys):
     """One row per (snapshot time, node): selected counters plus the
     average step time derived from the ``step_ms_*`` histogram deltas and
@@ -292,7 +357,7 @@ def main(argv=None):
                     help="path to a watchtower or autopilot journal.jsonl")
     ap.add_argument("--kind",
                     choices=("auto", "watchtower", "autopilot",
-                             "remediator"),
+                             "remediator", "fleet"),
                     default="auto",
                     help="journal flavor (default: detect from the meta "
                          "record)")
@@ -319,6 +384,8 @@ def main(argv=None):
         return autopilot_report(args, records, overrides)
     if kind == "remediator":
         return remediator_report(args, records, overrides)
+    if kind == "fleet":
+        return fleet_report(args, records, overrides)
     result = watchtower.replay_journal(records, config=overrides)
     rows = build_timeline(records, result, keys)
     if args.limit:

@@ -605,8 +605,14 @@ def export_model(export_dir, params, model_name, model_config=None,
     logger.info("exported %s to %s", model_name, export_dir)
 
 
-def load_model(export_dir):
-    """Load an export: returns ``(params, descriptor_dict)``."""
+def load_model(export_dir, validate=False):
+    """Load an export: returns ``(params, descriptor_dict)``.
+
+    ``validate=True`` additionally runs the nonfinite-leaf scan
+    :func:`restore_latest_valid` applies to training checkpoints and
+    raises ``ValueError`` on a poisoned export — the fleet's live-swap
+    path refuses to flip a replica onto NaN/Inf weights.
+    """
     import orbax.checkpoint as ocp
 
     export_dir = _fs_path(export_dir)
@@ -615,4 +621,10 @@ def load_model(export_dir):
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(os.path.join(export_dir, _PARAMS_DIR))
     ckptr.close()
+    if validate:
+        bad = _nonfinite_leaves(params)
+        if bad:
+            raise ValueError(
+                "export {} has nonfinite params at {}".format(
+                    export_dir, bad[:4]))
     return params, descriptor

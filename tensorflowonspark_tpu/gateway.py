@@ -90,7 +90,11 @@ _SLOW_BEAT = 8
 #: Typed shed reasons, also the ``reason=`` label set of
 #: ``tfos_serving_shed_total`` (emitted as zeros so scrapers see the full
 #: label space before the first shed).
-SHED_REASONS = ("overload", "deadline", "shutdown", "internal")
+#: ``unknown_model`` / ``no_capacity`` are shed by the fleet router
+#: (``fleet.FleetRouter``), not the gateway itself; they live in this
+#: vocabulary so the label space is one set fleet-wide.
+SHED_REASONS = ("overload", "deadline", "shutdown", "internal",
+                "unknown_model", "no_capacity")
 
 
 class _Hist(object):
@@ -200,13 +204,19 @@ class GatewayServer(object):
         # the latency leg: every completed request is good, only sheds
         # burn budget).  Shed requests always count against the budget.
         self.slo_latency_us = float(slo_latency_us or 0.0)
-        # model/version dimension, stubbed to one value until serving v2's
-        # multi-model fleet: ride heartbeats as string keys (merge_counters
-        # drops them from aggregates; the latch keeps them per-node).
+        # model/version dimension: rides heartbeats as string keys
+        # (merge_counters drops them from aggregates; the latch keeps them
+        # per-node) and the roster registration meta, which is how the
+        # fleet router (fleet.FleetRouter) maps replicas to versions.
         desc = getattr(server, "descriptor", None) or {}
         self.model = str(desc.get("model_name") or "default")
         self.model_version = str(model_version
                                  or desc.get("model_version") or "0")
+        # live version swap (fleet canary plane): the serving_load_version
+        # knob parks the swap here; the batcher applies it BETWEEN
+        # dispatches so in-flight batches drain on the old weights.
+        self._pending_swap = None
+        self._swap_token = None
 
         self._queue = collections.deque()
         self._cond = threading.Condition()
@@ -225,6 +235,11 @@ class GatewayServer(object):
         self.shed_by_reason = {reason: 0 for reason in SHED_REASONS}
         self.slo_good_total = 0
         self.slo_total = 0
+        self.swaps_total = 0        # completed live version swaps
+        self.swap_failed_total = 0  # refused/failed swap attempts
+        # rows whose outputs carried NaN/Inf — the version-labeled signal
+        # the canary controller rolls back on
+        self.nonfinite_total = 0
         self._lat_us = collections.deque(maxlen=_LAT_WINDOW)
         self._stage_hists = {
             "serving_queue_us": _Hist(),
@@ -279,6 +294,10 @@ class GatewayServer(object):
                 "addr": "{}:{}".format(self.host, self.port),
                 "job_name": "serving",
                 "task_index": self.task_index,
+                # fleet routing meta: the router maps (model, version) ->
+                # replica set off these fields (fleet.FleetRouter.sync_roster)
+                "model": self.model,
+                "model_version": self.model_version,
             }
             # Per-rung load-vs-compile verdicts travel on the roster
             # registration, so the driver can place them in tf_status
@@ -403,6 +422,11 @@ class GatewayServer(object):
             batch = self._collect_batch()
             if batch is None:
                 return  # stopped
+            if self._pending_swap is not None:
+                # apply the parked version swap between dispatches: the
+                # batch just collected (and everything before it) drained
+                # on the old weights; this batch runs on the new ones
+                self._apply_swap()
             if batch:
                 try:
                     self._dispatch(batch)
@@ -417,6 +441,8 @@ class GatewayServer(object):
         try:
             with self._cond:
                 while not self._queue and not self._stopped:
+                    if self._pending_swap is not None:
+                        return []  # idle replica: let the batcher swap now
                     self._cond.wait(timeout=0.1)
                 if self._stopped:
                     return None
@@ -451,6 +477,32 @@ class GatewayServer(object):
                         "deadline expired after {:.1f}ms in queue".format(
                             (time.monotonic() - req.arrival) * 1e3))
 
+    def _apply_swap(self):
+        """Apply the parked ``serving_load_version`` swap (batcher thread
+        only — the single-dispatcher contract ``ModelServer.swap_export``
+        documents).  Failures are counted and logged, never fatal: a bad
+        export must not take a serving replica down."""
+        swap, self._pending_swap = self._pending_swap, None
+        if not swap:
+            return
+        try:
+            version = self.server.swap_export(
+                swap["export_dir"], expected_version=swap.get("version"))
+        except Exception as e:
+            with self._metrics_lock:
+                self.swap_failed_total += 1
+            logger.warning("gateway %s: version swap to %s refused: %s",
+                           self.replica_id, swap.get("version"), e)
+            return
+        with self._metrics_lock:
+            self.model_version = str(version)
+            self.swaps_total += 1
+        telemetry.get_tracer().instant(
+            "serving/version_swap", model=self.model, version=version,
+            token=swap.get("token"))
+        logger.info("gateway %s: now serving %s@%s (swap token %s)",
+                    self.replica_id, self.model, version, swap.get("token"))
+
     def _dispatch(self, batch):
         tracer = telemetry.get_tracer()
         total = sum(r.count for r in batch)
@@ -478,6 +530,25 @@ class GatewayServer(object):
                          requests=len(batch)):
             outputs = self.server.predict_feed(feed, total)
         t_d1 = time.monotonic()
+        # nonfinite output scan: one vectorized pass per batch.  NaN/Inf
+        # rows are the version-labeled poison signal the watchtower's
+        # nonfinite rule and the fleet's canary rollback key on (bad
+        # weights pass param validation when finite but overflow in the
+        # matmul — only the outputs betray them).
+        bad_rows = 0
+        for v in outputs.values():
+            arr = np.asarray(v)
+            if arr.dtype.kind != "f":
+                continue
+            finite = np.isfinite(arr)
+            if not finite.all():
+                flat = finite.reshape(arr.shape[0], -1).all(axis=1)
+                bad_rows = max(bad_rows, int((~flat).sum()))
+        if bad_rows:
+            with self._metrics_lock:
+                self.nonfinite_total += bad_rows
+            tracer.instant("serving/nonfinite_output", rows=int(bad_rows),
+                           model=self.model, version=self.model_version)
         from tensorflowonspark_tpu.serving import bucket_for
 
         fill = 100.0 * total / bucket_for(total, self.server.buckets)
@@ -595,6 +666,27 @@ class GatewayServer(object):
             except (TypeError, ValueError):
                 logger.warning("gateway %s: bad serving_max_batch %r",
                                self.replica_id, batch)
+        swap = knobs.get("serving_load_version")
+        if isinstance(swap, dict) and swap.get("export_dir"):
+            # fleet live swap: park it for the batcher (it applies between
+            # dispatches), dedup'd by token — knob replies repeat until the
+            # coordinator's knob map changes
+            token = swap.get("token") or "{}@{}".format(
+                swap.get("model"), swap.get("version"))
+            if token != self._swap_token:
+                self._swap_token = token
+                if str(swap.get("model") or self.model) != self.model:
+                    with self._metrics_lock:
+                        self.swap_failed_total += 1
+                    logger.warning(
+                        "gateway %s: serving_load_version for model %r "
+                        "ignored (this replica serves %r)",
+                        self.replica_id, swap.get("model"), self.model)
+                else:
+                    self._pending_swap = dict(swap)
+                    logger.info("gateway %s: version swap to %s@%s parked",
+                                self.replica_id, self.model,
+                                swap.get("version"))
         with self._cond:
             self._cond.notify_all()  # a waiting batcher re-reads both
 
@@ -623,6 +715,11 @@ class GatewayServer(object):
                 # SLO error-budget feed for watchtower's slo_budget_burn
                 "serving_slo_good": self.slo_good_total,
                 "serving_slo_total": self.slo_total,
+                # fleet plane: live-swap tallies + the nonfinite-output
+                # poison signal the canary rollback keys on
+                "serving_swaps": self.swaps_total,
+                "serving_swap_failed": self.swap_failed_total,
+                "serving_nonfinite": self.nonfinite_total,
                 # model/version dimension (strings: latched per-node,
                 # dropped from merge_counters aggregates by design)
                 "serving_model": self.model,
@@ -832,10 +929,18 @@ class GatewayChannel(object):
 
 class ServingClient(object):
     """HA client over N gateway replicas: discovers the fleet from the
-    reservation roster (or a static address list) and retries a failed
-    request on a surviving replica.  Prediction is idempotent, so a
-    request that was in flight on a killed replica is simply re-sent —
-    this is how an *accepted* request survives a replica SIGKILL.
+    reservation roster (or a static address list), spreads requests
+    round-robin over the healthy replica set (picks counted per replica,
+    so a 3-replica fleet actually takes 1/3 of the load each), and
+    retries a failed request on a surviving replica.  Prediction is
+    idempotent, so a request that was in flight on a killed replica is
+    simply re-sent — this is how an *accepted* request survives a
+    replica SIGKILL.
+
+    A replica that fails at the transport level is marked unhealthy and
+    skipped by the rotation; once every replica is marked, the set is
+    reset and all are retried (a dead socket fails fast, so full-fleet
+    resets stay cheap).
 
     :class:`OverloadError` is NOT retried here: a typed shed is the
     gateway telling this client to back off, and hammering a sibling
@@ -853,10 +958,14 @@ class ServingClient(object):
         self.replicas = [transport.addr_tuple(a) for a in replicas]
         if not self.replicas:
             raise ValueError("no serving replicas found")
-        self._idx = 0
-        self._chan = None
+        self._rr = 0
+        self._chans = {}     # addr -> connected GatewayChannel
+        self._bad = set()    # addrs skipped by the rotation
         self.failovers = 0
         self._req_seq = 0
+        #: requests routed per replica ("host:port" -> count) — the
+        #: balance surface
+        self.picks = {}
         # client-side view of the wire: redials (transport failures that
         # rotated replicas) and typed sheds the gateway handed back.  Flat
         # counter names so callers can drop them onto any heartbeat.
@@ -877,34 +986,55 @@ class ServingClient(object):
         return ["{}:{}".format(m["host"], m["port"]) for m in info
                 if isinstance(m, dict) and m.get("job_name") == "serving"]
 
-    def _channel(self):
-        if self._chan is not None:
-            return self._chan
+    def _pick(self):
+        """Next replica in the round-robin rotation, skipping addresses
+        marked unhealthy; when everything is marked, the set resets so a
+        recovered fleet is rediscovered instead of erroring forever."""
+        if len(self._bad) >= len(self.replicas):
+            self._bad.clear()
+        for _ in range(len(self.replicas)):
+            addr = self.replicas[self._rr % len(self.replicas)]
+            self._rr += 1
+            if addr not in self._bad:
+                return addr
+        return self.replicas[self._rr % len(self.replicas)]
+
+    def _channel(self, addr):
+        chan = self._chans.get(addr)
+        if chan is not None:
+            return chan
         last = None
         for _ in range(len(self.replicas)):
-            addr = self.replicas[self._idx % len(self.replicas)]
             try:
-                self._chan = GatewayChannel(addr, timeout=self.timeout,
-                                            client_id=self.client_id)
-                return self._chan
+                chan = GatewayChannel(addr, timeout=self.timeout,
+                                      client_id=self.client_id)
+                self._chans[addr] = chan
+                return chan
             except OSError as e:
                 last = e
-                self._idx += 1
+                self._mark_bad(addr)
+                addr = self._pick()
+                chan = self._chans.get(addr)
+                if chan is not None:
+                    return chan
         raise ConnectionError(
             "no serving replica reachable (tried {}): {}".format(
                 self.replicas, last))
 
-    def _drop_channel(self):
-        if self._chan is not None:
-            try:
-                self._chan.transport.close()
-            except OSError:
-                pass
-            self._chan = None
-        self._idx += 1
+    def _mark_bad(self, addr):
+        self._bad.add(addr)
         self.failovers += 1
         self.counters["serving_client_redials"] += 1
         telemetry.get_tracer().counter_add("serving_client_redials")
+
+    def _drop_channel(self, addr):
+        chan = self._chans.pop(addr, None)
+        if chan is not None:
+            try:
+                chan.transport.close()
+            except OSError:
+                pass
+        self._mark_bad(addr)
 
     def predict(self, feed, count, deadline_ms=None):
         """Predict with failover: transport-level failures rotate to the
@@ -921,11 +1051,20 @@ class ServingClient(object):
         flow_id = tracer.new_flow_id()
         last = None
         for _ in range(len(self.replicas) + 1):
+            addr = self._pick()
             try:
-                return self._channel().predict(feed, count,
-                                               deadline_ms=deadline_ms,
-                                               request_id=request_id,
-                                               flow_id=flow_id)
+                chan = self._channel(addr)
+            except (OSError, ConnectionError) as e:
+                last = e
+                continue
+            addr = chan.addr  # _channel may have failed over while dialing
+            key = "{}:{}".format(*addr)
+            self.picks[key] = self.picks.get(key, 0) + 1
+            try:
+                return chan.predict(feed, count,
+                                    deadline_ms=deadline_ms,
+                                    request_id=request_id,
+                                    flow_id=flow_id)
             except OverloadError as e:
                 self.counters["serving_client_shed"] += 1
                 tracer.counter_add("serving_client_shed")
@@ -937,7 +1076,7 @@ class ServingClient(object):
             except (EOFError, OSError, ConnectionError,
                     TransportError) as e:
                 last = e
-                self._drop_channel()
+                self._drop_channel(addr)
         if flow_id:
             tracer.flow_end(telemetry.SERVING_REQUEST_FLOW, flow_id,
                             req=request_id, stage="failed")
@@ -945,6 +1084,9 @@ class ServingClient(object):
             "predict failed on every replica: {!r}".format(last))
 
     def close(self):
-        if self._chan is not None:
-            self._chan.close()
-            self._chan = None
+        for addr in list(self._chans):
+            chan = self._chans.pop(addr)
+            try:
+                chan.close()
+            except (OSError, EOFError):
+                pass

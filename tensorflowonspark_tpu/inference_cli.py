@@ -138,6 +138,27 @@ def serve_forever(args):
 
     telemetry.configure_from_meta({})
     telemetry.install_sigusr1()
+    model_version = getattr(args, "model_version", None)
+    if getattr(args, "registry", None):
+        # fleet mode: resolve --model NAME[@VERSION] through the model
+        # registry instead of pinning an export path; the registry entry
+        # also supplies the version label and (absent an explicit flag)
+        # the shared AOT warm dir
+        from tensorflowonspark_tpu import fleet
+
+        registry = fleet.ModelRegistry(args.registry)
+        name, _, pinned = (args.model or "").partition("@")
+        if not name:
+            raise SystemExit("--registry requires --model NAME[@VERSION]")
+        entry = registry.resolve(name, pinned or model_version or None)
+        args.export_dir = entry["export_dir"]
+        model_version = entry["version"]
+        if entry.get("warm_dir") and not args.warm_cache_dir:
+            args.warm_cache_dir = entry["warm_dir"]
+        logger.info("registry %s resolved %s@%s -> %s", args.registry,
+                    name, model_version, args.export_dir)
+    elif not args.export_dir:
+        raise SystemExit("--serve needs --export_dir or --registry/--model")
     if args.warm_cache_dir:
         # Warm-start compile plane: persistent XLA cache + serialized
         # bucket-rung executables under one root, so a restarted replica
@@ -155,7 +176,8 @@ def serve_forever(args):
         max_queue=args.max_queue, roster_addr=args.roster,
         replica_id=args.replica_id, task_index=args.task_index,
         heartbeat_interval=args.heartbeat,
-        slo_latency_us=args.slo_latency_us)
+        slo_latency_us=args.slo_latency_us,
+        model_version=model_version)
     host, port = gw.start()
     print("serving replica {} ready on {}:{} (buckets {})".format(
         gw.replica_id, host, port, list(server.buckets)), flush=True)
@@ -174,7 +196,10 @@ def main(argv=None):
         description="Batch inference over TFRecords with a framework export "
                     "(reference Inference.scala); --serve runs an online "
                     "continuous-batching gateway replica instead")
-    parser.add_argument("--export_dir", required=True)
+    parser.add_argument("--export_dir", default=None,
+                        help="export directory (required for batch mode; "
+                             "--serve can resolve one via --registry/--model "
+                             "instead)")
     parser.add_argument("--input", default=None,
                         help="TFRecord directory (required unless --serve)")
     parser.add_argument("--schema_hint", default=None,
@@ -217,6 +242,16 @@ def main(argv=None):
                             "microseconds: completed requests at or under "
                             "it count as serving_slo_good (0 = latency "
                             "leg disarmed; sheds always burn budget)")
+    serve.add_argument("--registry", default=None,
+                       help="model-fleet registry root (fleet.ModelRegistry): "
+                            "resolve the export through the registry instead "
+                            "of --export_dir")
+    serve.add_argument("--model", default=None,
+                       help="with --registry: model NAME or NAME@VERSION "
+                            "(default version = the model's live default)")
+    serve.add_argument("--model-version", default=None, dest="model_version",
+                       help="version label override for serving metrics / "
+                            "roster meta (set automatically by --registry)")
     serve.add_argument("--warm-cache-dir", default=None,
                        dest="warm_cache_dir",
                        help="warm-start root: persistent XLA compile cache "
@@ -230,6 +265,8 @@ def main(argv=None):
             args.max_batch = args.batch_size
         serve_forever(args)
         return
+    if not args.export_dir:
+        parser.error("--export_dir is required in batch mode")
     if not args.input:
         parser.error("--input is required (or pass --serve for online mode)")
 

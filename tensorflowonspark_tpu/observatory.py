@@ -567,7 +567,8 @@ class ObservatoryServer(object):
                  host="0.0.0.0", port=0, window_secs=60.0,
                  profile_fn=None, profiler_addresses_fn=None,
                  capture_status_fn=None, watchtower=None, autopilot=None,
-                 remediator=None, coordinator_fn=None, beat_ages_fn=None):
+                 remediator=None, coordinator_fn=None, beat_ages_fn=None,
+                 fleet=None):
         """``profile_fn(duration_ms=, steps=)`` backs ``GET /profile``
         (typically ``CaptureCoordinator.trigger``; 503 when absent).
         ``profiler_addresses_fn`` / ``capture_status_fn`` enrich ``/status``
@@ -597,6 +598,7 @@ class ObservatoryServer(object):
         self.watchtower = watchtower
         self.autopilot = autopilot
         self.remediator = remediator
+        self.fleet = fleet
         self._build_info = None
         self.ring = ring if ring is not None else SampleRing()
         self._window_secs = window_secs
@@ -846,6 +848,30 @@ class ObservatoryServer(object):
             return 500, json.dumps({"error": repr(e)})
         return 200, json.dumps(result, default=str)
 
+    def _fleet_json(self):
+        """``GET /fleet``: the fleet plane's one-stop JSON — registry
+        snapshot (models, versions, statuses, defaults), router status
+        (replica table, picks, splits, sheds, budgets), and the canary
+        controller's pending action + decision history.  503 until fleet
+        objects are attached."""
+        if not self.fleet:
+            return 503, json.dumps({"error": "no fleet plane attached"})
+        doc = {}
+        try:
+            reg = self.fleet.get("registry")
+            if reg is not None:
+                doc["registry"] = reg.snapshot()
+            router = self.fleet.get("router")
+            if router is not None:
+                doc["router"] = router.status()
+            canary = self.fleet.get("canary")
+            if canary is not None:
+                doc["canary"] = canary.status()
+        except Exception as e:
+            logger.exception("observatory: fleet surface failed")
+            return 500, json.dumps({"error": repr(e)})
+        return 200, json.dumps(doc, default=str)
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self):
@@ -880,6 +906,10 @@ class ObservatoryServer(object):
                     code, text = observatory._remediations_json(query)
                     body = text.encode("utf-8")
                     ctype = "application/json"
+                elif path in ("/fleet", "/fleet/"):
+                    code, text = observatory._fleet_json()
+                    body = text.encode("utf-8")
+                    ctype = "application/json"
                 elif path in ("/slow", "/slow/"):
                     code, text = observatory._slow_json(query)
                     body = text.encode("utf-8")
@@ -887,7 +917,7 @@ class ObservatoryServer(object):
                 elif path == "/":
                     body = (b"tfos observatory: /metrics /status "
                             b"/profile /alerts /autopilot /remediations "
-                            b"/slow\n")
+                            b"/fleet /slow\n")
                     ctype = "text/plain; charset=utf-8"
                 else:
                     self.send_error(404)

@@ -58,6 +58,7 @@ and snapshot records (``scripts/metrics_replay.py --kind remediator``).
 See docs/FAULT_TOLERANCE.md ("Self-healing: the remediator").
 """
 
+import inspect
 import logging
 import math
 import os
@@ -73,6 +74,20 @@ from .watchtower import read_journal, window_deltas
 logger = logging.getLogger(__name__)
 
 JOURNAL_VERSION = 1
+
+def _alert_model_labels(alert):
+    """``{"model", "version"}`` spawn substitutions off an alert's
+    version labels (the watchtower stamps serving alerts with the
+    replica's latched ``serving_model``/``serving_model_version``)."""
+    if not isinstance(alert, dict):
+        return None
+    out = {}
+    if alert.get("model") is not None:
+        out["model"] = alert["model"]
+    if alert.get("version") is not None:
+        out["version"] = alert["version"]
+    return out or None
+
 
 #: watchtower rule -> action family
 RULE_ACTIONS = {
@@ -198,12 +213,26 @@ class _SubprocessPool(object):
         stopped) so budgets reflect live capacity."""
         self._procs = [p for p in self._procs if p.poll() is None]
 
-    def spawn(self):
+    def spawn(self, subst=None):
+        """Launch one member.  ``subst`` (e.g. ``{"model": ...,
+        "version": ...}``) is substituted into ``{model}``-style argv
+        placeholders, so a serving scale-out provisions capacity for the
+        model the alert names — not a hardcoded one.  Placeholders with
+        no substitution are left verbatim (an argv without placeholders
+        is unchanged)."""
         if not self.argv:
             raise RuntimeError("no spawn argv configured for %s" % self.name)
-        proc = subprocess.Popen(self.argv)
+        argv = self.argv
+        if subst:
+            class _Keep(dict):
+                def __missing__(self, key):
+                    return "{" + key + "}"
+            safe = _Keep({k: str(v) for k, v in subst.items()
+                          if v is not None})
+            argv = [a.format_map(safe) if "{" in a else a for a in argv]
+        proc = subprocess.Popen(argv)
         self._procs.append(proc)
-        return {"pid": proc.pid, "argv": self.argv, "pool": self.name,
+        return {"pid": proc.pid, "argv": argv, "pool": self.name,
                 "size": len(self._procs)}
 
     def retire_newest(self, timeout=5.0):
@@ -276,7 +305,9 @@ class Remediator(object):
                         (lambda: self._workers.retire_newest())
                         if self._workers.argv else None)
         acts.setdefault("spawn_replica",
-                        (lambda: self._replicas.spawn())
+                        (lambda alert=None:
+                         self._replicas.spawn(subst=_alert_model_labels(
+                             alert)))
                         if self._replicas.argv else None)
         acts.setdefault("retire_replica",
                         (lambda: self._replicas.retire_newest())
@@ -550,7 +581,15 @@ class Remediator(object):
         if action == "scale_in_workers":
             return fns["retire_worker"]()
         if action == "scale_out_serving":
-            return fns["spawn_replica"]()
+            # pass the alert when the actuator takes it: its model/version
+            # labels steer the spawn argv at the burning model (injected
+            # zero-arg test/replay actuators keep working unchanged)
+            fn = fns["spawn_replica"]
+            try:
+                takes_alert = bool(inspect.signature(fn).parameters)
+            except (TypeError, ValueError):
+                takes_alert = False
+            return fn(alert) if takes_alert else fn()
         if action == "scale_in_serving":
             return fns["retire_replica"]()
         raise ValueError("unknown action %r" % action)

@@ -214,6 +214,17 @@ class Reservations(object):
         with self._lock:
             return list(self._released)
 
+    def by_job(self, job_name):
+        """Copies of the reservations registered under ``job_name`` — the
+        fleet router's discovery read (``job_name="serving"`` rows carry
+        ``model``/``model_version`` meta; see fleet.FleetRouter.sync_roster).
+        Does not wait for a full roster: serving fleets are elastic, so
+        callers see whatever replicas are registered right now."""
+        with self._lock:
+            return [dict(meta) for meta in self._reservations
+                    if isinstance(meta, dict)
+                    and meta.get("job_name") == job_name]
+
     def notify_waiters(self):
         """Wake every ``wait()``er for an out-of-band re-check (used by the
         liveness monitor so a dead node unblocks the driver immediately

@@ -400,6 +400,8 @@ class ModelServer(object):
         self.descriptor = desc
         self.signature = _normalize_signature(desc.get("input_signature"))
         self.from_stablehlo = False
+        #: Completed live weight swaps (:meth:`swap_export`).
+        self.swap_count = 0
 
         exported = self._load_stablehlo(export_dir, desc)
         if exported is not None:
@@ -423,6 +425,67 @@ class ModelServer(object):
         metrics) — stubbed to one value until multi-model serving v2."""
         return str(self.descriptor.get("model_version") or "0")
 
+    def swap_export(self, export_dir, expected_version=None):
+        """Live weight swap: flip to ``export_dir``'s params with ZERO
+        recompiles.
+
+        Every dispatch path takes ``self.params`` as an argument
+        (``warm(self.params, feed)`` / ``self._predict(self.params,
+        feed)``), so replacing the params tree reuses every compiled
+        program and warm-rung executable as long as the new tree is
+        aval-identical.  The swap is refused (:class:`fleet.SwapRefused`)
+        when the new export would retrace — different model name,
+        model_config, input signature, or params tree structure/shapes/
+        dtypes — or when the new params carry nonfinite leaves (the
+        quarantine discipline of ``restore_latest_valid`` applied at the
+        swap boundary).
+
+        Single-dispatcher contract: the gateway applies swaps on its
+        batcher thread between dispatches, so in-flight batches drain on
+        the old weights — the old version is drained, never killed.
+        Returns the new version string.
+        """
+        import jax
+        import numpy as np
+
+        from tensorflowonspark_tpu import checkpoint, fleet
+
+        params, desc = checkpoint.load_model(export_dir, validate=True)
+        if str(desc.get("model_name")) != str(
+                self.descriptor.get("model_name")):
+            raise fleet.SwapRefused(
+                "swap refused: model {} != {}".format(
+                    desc.get("model_name"),
+                    self.descriptor.get("model_name")))
+        if (desc.get("model_config") or {}) != (
+                self.descriptor.get("model_config") or {}):
+            raise fleet.SwapRefused("swap refused: model_config differs "
+                                    "(would recompile)")
+        if _normalize_signature(desc.get("input_signature")) != \
+                self.signature:
+            raise fleet.SwapRefused("swap refused: input signature differs "
+                                    "(would recompile)")
+
+        def _aval(x):
+            arr = np.asarray(x)
+            return (arr.shape, str(arr.dtype))
+
+        old = jax.tree_util.tree_map(_aval, self.params)
+        new = jax.tree_util.tree_map(_aval, params)
+        if old != new:
+            raise fleet.SwapRefused(
+                "swap refused: params tree structure/shapes/dtypes differ "
+                "(would recompile)")
+        self.params = params
+        self.descriptor = dict(desc)
+        if expected_version is not None:
+            self.descriptor["model_version"] = str(expected_version)
+        self.swap_count += 1
+        logger.info("swapped model %s to version %s from %s (zero "
+                    "recompiles: %d warm rungs kept)", self.model_name,
+                    self.model_version, export_dir, len(self._warm_exec))
+        return self.model_version
+
     def _registry_predict(self):
         """Rebuild the apply fn from the model registry (the no-artifact
         fallback path)."""
@@ -430,8 +493,14 @@ class ModelServer(object):
 
         from tensorflowonspark_tpu.models import get_model
 
-        model = get_model(self.descriptor["model_name"],
-                          **self.descriptor.get("model_config", {}))
+        # fleet deployments name models by their registry identity (e.g.
+        # "ranker-b"), which need not be a registered architecture: the
+        # model_config's "architecture" key names the compute graph, the
+        # descriptor's model_name stays the fleet-facing label
+        config = dict(self.descriptor.get("model_config") or {})
+        arch = config.pop("architecture", None) \
+            or self.descriptor["model_name"]
+        model = get_model(arch, **config)
         return jax.jit(build_apply_fn(model, self.signature))
 
     @staticmethod

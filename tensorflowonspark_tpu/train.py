@@ -1180,7 +1180,7 @@ class Trainer(object):
 
 def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
                    max_steps=None, steps_per_call=1, profiler=None,
-                   transfer_guard=None):
+                   transfer_guard=None, publish=None):
     """Supervised :meth:`Trainer.fit_feed`: restore-latest, train with
     periodic checkpoints, and on a retryable failure back off, re-restore,
     and try again from the last saved step.
@@ -1203,6 +1203,14 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
         it is stepped once per dispatch and used as a context manager around
         every attempt, so an exception mid-capture stops the trace instead
         of leaking it into the retry's capture.
+      publish: optional train-to-serve handoff spec
+        (``fleet.publish_trained``): after the final checkpoint lands, the
+        run's finiteness-validated params are exported and published to the
+        model registry as a ``staging`` version — which a running canary
+        controller walks to live with no operator action.  The registry
+        entry rides the stats dict as ``stats["published"]``; a publish
+        failure is logged and reported as ``stats["publish_error"]``
+        without failing the (already successful) training run.  Chief-only.
 
     Returns the final fit stats dict.
     """
@@ -1295,6 +1303,24 @@ def fit_supervised(trainer, feed_factory, ckpt_manager, retry_policy=None,
                 ckpt_manager.maybe_save(int(trainer.state.step), trainer.state,
                                         force=True)
                 ckpt_manager.wait_until_finished()
+                if publish and ckpt_manager.is_chief:
+                    from tensorflowonspark_tpu import fleet as fleet_mod
+
+                    try:
+                        with tracer.span("train/publish"):
+                            stats["published"] = fleet_mod.publish_trained(
+                                publish, trainer.state.params,
+                                int(trainer.state.step))
+                        logger.info(
+                            "supervised fit: published %s@%s to registry",
+                            stats["published"]["model"],
+                            stats["published"]["version"])
+                    except Exception as e:
+                        # the training run succeeded; a handoff failure is
+                        # reported, not raised
+                        logger.warning("train-to-serve publish failed",
+                                       exc_info=True)
+                        stats["publish_error"] = repr(e)
                 return stats
             except fault_mod.PoisonRollback as rb:
                 rollbacks += 1

@@ -154,6 +154,20 @@ def _finite(v):
     return _is_num(v) and math.isfinite(v)
 
 
+def _model_version_labels(counters):
+    """``{"model": ..., "version": ...}`` from a serving node's latched
+    ``serving_model`` / ``serving_model_version`` string counters — the
+    per-model labels the fleet plane keys alerts on.  Empty for training
+    nodes, so train-side alerts are unchanged."""
+    out = {}
+    if isinstance(counters, dict):
+        if counters.get("serving_model") is not None:
+            out["model"] = str(counters["serving_model"])
+        if counters.get("serving_model_version") is not None:
+            out["version"] = str(counters["serving_model_version"])
+    return out
+
+
 def json_safe(obj):
     """Deep-copy ``obj`` with nonfinite floats replaced by ``None`` so
     journal lines and ``GET /alerts`` bodies stay strict JSON (a NaN'd
@@ -440,14 +454,19 @@ class RuleEngine(object):
     def _rule_nonfinite(self, window, now):
         """Fire whenever a node's cumulative nonfinite tallies (the
         Trainer's ``train_nonfinite_loss`` / ``train_nonfinite_grad``
-        window-boundary counters) grow past what this engine already
-        reported — one alert per NEW corruption, not one per tick."""
+        window-boundary counters, or a serving replica's
+        ``serving_nonfinite`` output-poison counter) grow past what this
+        engine already reported — one alert per NEW corruption, not one
+        per tick.  Serving alerts carry the replica's latched
+        model/version labels so the fleet's canary controller can match
+        the poison to the version it is canarying."""
         alerts = []
         for node, samples in window.items():
             _, latest = samples[-1]
             total = 0
             detail = {}
-            for key in ("train_nonfinite_loss", "train_nonfinite_grad"):
+            for key in ("train_nonfinite_loss", "train_nonfinite_grad",
+                        "serving_nonfinite"):
                 v = latest.get(key, 0)
                 if _is_num(v) and v > 0:
                     total += v
@@ -455,6 +474,7 @@ class RuleEngine(object):
             seen = self._nonfinite_seen.get(node, 0)
             if total > seen:
                 self._nonfinite_seen[node] = total
+                labels = _model_version_labels(latest)
                 alerts.append(self._alert(
                     "nonfinite", now, executor=node, severity="crit",
                     value=total, threshold=0,
@@ -468,10 +488,10 @@ class RuleEngine(object):
                                       "train_loss_max"),
                                   train_grad_norm_max=latest.get(
                                       "train_grad_norm_max")),
-                    message="executor {} reported {} nonfinite training "
+                    message="executor {} reported {} nonfinite "
                             "value(s): {}".format(node, total, detail or
                                                   {"total": total}),
-                    **{k: v for k, v in detail.items()}))
+                    **dict(detail, **labels)))
         return alerts
 
     # -- plane-level rules -------------------------------------------------
@@ -692,6 +712,9 @@ class RuleEngine(object):
                 threshold=threshold,
                 kind="page" if page else "ticket",
                 objective=objective, shed=shed,
+                # version-labeled burn: the fleet's canary rollback and
+                # the remediator's per-model scale-out both key on these
+                **_model_version_labels(samples[-1][1]),
                 evidence={"objective": objective,
                           "budget": round(budget, 6),
                           "kind": "page" if page else "ticket",

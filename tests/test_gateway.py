@@ -272,11 +272,13 @@ def test_serving_client_retries_on_survivor(linear_export):
         feed = {"x": np.asarray([[2.0, 0.0]], np.float32)}
         assert abs(float(client.predict(feed, 1)["output"][0][0])
                    - 4.0) < 1e-5
-        # kill whichever replica the client is pinned to; the next predict
-        # must fail over to the survivor instead of surfacing the EOF
-        gws[client._idx % 2].stop()
-        assert abs(float(client.predict(feed, 1)["output"][0][0])
-                   - 4.0) < 1e-5
+        # kill one replica; the balanced rotation will land on it within
+        # two predicts and must fail over to the survivor instead of
+        # surfacing the EOF
+        gws[0].stop()
+        for _ in range(2):
+            assert abs(float(client.predict(feed, 1)["output"][0][0])
+                       - 4.0) < 1e-5
         assert client.failovers >= 1
         client.close()
     finally:
@@ -298,6 +300,27 @@ def test_overload_is_not_retried_on_siblings(linear_export):
         client.close()
     finally:
         g.stop()
+
+
+def test_serving_client_round_robin_balances_picks(linear_export):
+    servers = [serving.ModelServer(linear_export, batch_size=4)
+               for _ in range(2)]
+    gws = [GatewayServer(s, max_wait_ms=1.0) for s in servers]
+    addrs = ["{}:{}".format(*g.start()) for g in gws]
+    try:
+        client = ServingClient(replicas=addrs)
+        feed = {"x": np.asarray([[1.0, 1.0]], np.float32)}
+        for _ in range(8):
+            client.predict(feed, 1)
+        # the rotation splits load exactly in half, and the picks surface
+        # proves it per replica
+        assert sorted(client.picks.values()) == [4, 4]
+        assert set(client.picks) == set(addrs)
+        assert gws[0].requests_total == gws[1].requests_total == 4
+        client.close()
+    finally:
+        for g in gws:
+            g.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -7,6 +7,7 @@ observatory surfaces (``tfos_remediation_actions_total`` +
 ``/remediations``)."""
 
 import json
+import sys
 import threading
 import time
 
@@ -497,3 +498,48 @@ class TestObservatorySurfaces:
         obs = observatory.ObservatoryServer(lambda: {})
         code, _body = obs._remediations_json("")
         assert code == 503
+
+
+class TestFleetSpawnLabels:
+    def test_spawn_substitutes_alert_labels_into_argv(self):
+        pool = remediator._SubprocessPool(
+            [sys.executable, "-c", "pass",
+             "--model={model}", "--model-version={version}"], "serving")
+        try:
+            info = pool.spawn(subst={"model": "lin", "version": "7"})
+            assert info["argv"][-2:] == ["--model=lin", "--model-version=7"]
+            # no labels on the alert: placeholders stay verbatim rather
+            # than KeyError-ing the spawn
+            info = pool.spawn(subst={})
+            assert info["argv"][-2:] == ["--model={model}",
+                                         "--model-version={version}"]
+        finally:
+            pool.stop_all()
+
+    def test_alert_labels_reach_spawn_actuator(self):
+        clock = {"now": T0}
+        ring = _FakeRing()
+        got = []
+        calls = _Calls()
+        actions = calls.actions()
+        actions["spawn_replica"] = lambda alert=None: got.append(alert)
+        plane = remediator.Remediator(
+            ring, actions=actions,
+            config={"confirm_windows": {"scale_out_serving": 1},
+                    "settle_ticks": 1},
+            clock=lambda: clock["now"])
+        ring.set_window("0", [
+            (clock["now"] - 4, {"serving_requests": 0,
+                                "serving_p99_us_max": 9000.0}),
+            (clock["now"], {"serving_requests": 100,
+                            "serving_p99_us_max": 9000.0})])
+        alert = _alert("latency_slo_burn", 0, clock["now"], persists=2)
+        alert.update(model="lin", version="2")
+        plane.observe_alert(alert)
+        plane.tick()
+        # the version-labeled alert itself reached the actuator, so its
+        # labels can steer the spawn argv at the burning model
+        assert got and got[0]["model"] == "lin"
+        assert got[0]["version"] == "2"
+        assert remediator._alert_model_labels(got[0]) == {
+            "model": "lin", "version": "2"}
